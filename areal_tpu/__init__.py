@@ -1,0 +1,9 @@
+"""areal_tpu — a TPU-native asynchronous RL (PPO) training framework.
+
+Brand-new JAX/XLA/Pallas implementation with the capabilities of the AReaL
+reference system (structural blueprint in /root/repo/SURVEY.md). The compute
+path is GSPMD/pjit over `jax.sharding.Mesh`; the system fabric (workers,
+streams, staleness control) is asyncio/ZMQ Python.
+"""
+
+__version__ = "0.1.0"

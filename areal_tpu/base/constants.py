@@ -1,0 +1,92 @@
+"""Per-process global context.
+
+Parity target: ``realhf/base/constants.py:215`` — experiment/trial names,
+per-model scoped context (the reference swaps Megatron process groups per
+model role with ``model_scope``; here the scoped object is the model role's
+``jax.sharding.Mesh`` and axis names), and canonical filesystem layout.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_experiment_name: Optional[str] = None
+_trial_name: Optional[str] = None
+_model_scope: list = []
+_model_ctx: Dict[str, Any] = {}
+
+
+def set_experiment_trial_names(experiment: str, trial: str) -> None:
+    global _experiment_name, _trial_name
+    _experiment_name = experiment
+    _trial_name = trial
+
+
+def experiment_name() -> str:
+    if _experiment_name is None:
+        raise RuntimeError("experiment name unset")
+    return _experiment_name
+
+
+def trial_name() -> str:
+    if _trial_name is None:
+        raise RuntimeError("trial name unset")
+    return _trial_name
+
+
+def has_model_scope() -> bool:
+    return bool(_model_scope)
+
+
+def current_model_name() -> str:
+    if not _model_scope:
+        raise RuntimeError("not inside model_scope")
+    return _model_scope[-1]
+
+
+@contextmanager
+def model_scope(name: str):
+    _model_scope.append(name)
+    try:
+        yield
+    finally:
+        _model_scope.pop()
+
+
+def set_model_context(name: str, **ctx) -> None:
+    _model_ctx.setdefault(name, {}).update(ctx)
+
+
+def model_context(name: Optional[str] = None) -> Dict[str, Any]:
+    return _model_ctx.get(name or current_model_name(), {})
+
+
+# ---- filesystem layout ----
+
+def get_cache_root() -> str:
+    return os.environ.get(
+        "AREAL_CACHE_ROOT", os.path.join("/tmp", getpass.getuser(), "areal_tpu")
+    )
+
+
+def get_log_root(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    return os.path.join(
+        get_cache_root(), "logs", experiment or experiment_name(), trial or trial_name()
+    )
+
+
+def get_save_root(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    return os.path.join(
+        get_cache_root(), "checkpoints", experiment or experiment_name(), trial or trial_name()
+    )
+
+
+def get_param_realloc_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    """Where the trainer publishes weights for the generation fleet (the disk
+    weight-sync path; reference: model_worker.py:1053 DISK realloc impl)."""
+    return os.path.join(
+        get_cache_root(), "param_realloc", experiment or experiment_name(), trial or trial_name()
+    )

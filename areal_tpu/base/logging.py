@@ -1,0 +1,48 @@
+"""Colored, leveled logging. Parity target: ``realhf/base/logging.py``."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured = False
+
+
+def getLogger(name: str = "areal", subname: str | None = None) -> logging.Logger:
+    global _configured
+    if not _configured:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(_ColorFormatter(_FORMAT, _DATE))
+        root = logging.getLogger("areal")
+        root.addHandler(h)
+        root.setLevel(os.environ.get("AREAL_LOG_LEVEL", "INFO").upper())
+        root.propagate = False
+        _configured = True
+    if subname:
+        name = f"{name}.{subname}"
+    if not name.startswith("areal"):
+        name = f"areal.{name}"
+    return logging.getLogger(name)

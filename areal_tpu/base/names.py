@@ -1,0 +1,77 @@
+"""Name-resolve key schema for distributed discovery.
+
+Parity target: ``realhf/base/names.py:11-108``. All coordination state lives
+under ``{root}/{experiment}/{trial}/...`` keys in a name-resolve store.
+"""
+
+from __future__ import annotations
+
+ROOT = "areal_tpu"
+
+
+def _base(experiment: str, trial: str) -> str:
+    return f"{ROOT}/{experiment}/{trial}"
+
+
+def trial_root(experiment: str, trial: str) -> str:
+    return _base(experiment, trial)
+
+
+def worker_status(experiment: str, trial: str, worker: str) -> str:
+    return f"{_base(experiment, trial)}/status/{worker}"
+
+
+def worker_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/status/"
+
+
+def request_reply_stream(experiment: str, trial: str, stream: str) -> str:
+    return f"{_base(experiment, trial)}/stream/{stream}"
+
+
+def push_pull_stream(experiment: str, trial: str, stream: str) -> str:
+    return f"{_base(experiment, trial)}/push_pull/{stream}"
+
+
+def push_pull_stream_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/push_pull/"
+
+
+def gen_servers(experiment: str, trial: str, server_id: str) -> str:
+    return f"{_base(experiment, trial)}/gen_servers/{server_id}"
+
+
+def gen_server_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/gen_servers/"
+
+
+def gen_server_manager(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/gserver_manager"
+
+
+def model_version(experiment: str, trial: str, role: str) -> str:
+    return f"{_base(experiment, trial)}/model_version/{role}"
+
+
+def experiment_status(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/exp_status"
+
+
+def distributed_peer(experiment: str, trial: str, peer: str) -> str:
+    return f"{_base(experiment, trial)}/peers/{peer}"
+
+
+def distributed_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/peers/"
+
+
+def used_data_ids(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/used_data"
+
+
+def metric_server(experiment: str, trial: str, group: str, index: str) -> str:
+    return f"{_base(experiment, trial)}/metrics/{group}/{index}"
+
+
+def metric_server_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/metrics/"

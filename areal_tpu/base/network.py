@@ -1,0 +1,59 @@
+"""Free-port discovery and host identification.
+
+Parity target: ``realhf/base/network.py:25`` (find_free_port w/ lockfiles,
+gethostip).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import socket
+from contextlib import closing
+from typing import List
+
+
+def gethostname() -> str:
+    return socket.gethostname()
+
+
+def gethostip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def find_free_port(lockfile_root: str | None = None) -> int:
+    """Find a free TCP port. When ``lockfile_root`` is given, takes an flock on
+    a per-port lockfile so concurrent processes on one host don't race."""
+    for _ in range(100):
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        if lockfile_root is None:
+            return port
+        os.makedirs(lockfile_root, exist_ok=True)
+        path = os.path.join(lockfile_root, f"port{port}.lock")
+        f = open(path, "w")
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return port
+        except OSError:
+            f.close()
+            continue
+    raise RuntimeError("could not find a free port")
+
+
+def find_multiple_free_ports(n: int, lockfile_root: str | None = None) -> List[int]:
+    ports = []
+    while len(ports) < n:
+        p = find_free_port(lockfile_root)
+        if p not in ports:
+            ports.append(p)
+    return ports

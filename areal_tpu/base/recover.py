@@ -1,0 +1,93 @@
+"""Checkpoint/resume bookkeeping.
+
+Parity target: ``realhf/base/recover.py:19-111`` — ``RecoverInfo`` holds step
+counters, frequency-control states, and hashes of already-consumed data so a
+restarted run neither repeats trained samples nor skips untrained ones;
+``discover_ckpt`` finds the latest usable checkpoint under the run directory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+    def next(self) -> "StepInfo":
+        return StepInfo(self.epoch, self.epoch_step + 1, self.global_step + 1)
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    recover_start: StepInfo = dataclasses.field(default_factory=StepInfo)
+    last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
+    save_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    ckpt_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    eval_ctl_states: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    data_loading_dp_idx: int = 0
+    hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RecoverInfo":
+        d = dict(d)
+        d["recover_start"] = StepInfo(**d.get("recover_start", {}))
+        d["last_step_info"] = StepInfo(**d.get("last_step_info", {}))
+        return cls(**d)
+
+
+def recover_info_path(run_dir: str) -> str:
+    return os.path.join(run_dir, "recover_info.json")
+
+
+def dump(run_dir: str, info: RecoverInfo) -> None:
+    os.makedirs(run_dir, exist_ok=True)
+    path = recover_info_path(run_dir)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(info.to_json(), f, indent=2)
+    os.replace(tmp, path)
+
+
+def load(run_dir: str) -> Optional[RecoverInfo]:
+    path = recover_info_path(run_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return RecoverInfo.from_json(json.load(f))
+
+
+def ckpt_dirname(epoch: int, epoch_step: int, global_step: int) -> str:
+    return f"epoch{epoch}epochstep{epoch_step}globalstep{global_step}"
+
+
+def parse_ckpt_dirname(name: str) -> Optional[StepInfo]:
+    import re
+
+    m = re.fullmatch(r"epoch(\d+)epochstep(\d+)globalstep(\d+)", name)
+    if not m:
+        return None
+    return StepInfo(int(m.group(1)), int(m.group(2)), int(m.group(3)))
+
+
+def discover_ckpt(save_root: str) -> Optional[str]:
+    """Latest checkpoint directory (by global step) under save_root."""
+    if not os.path.isdir(save_root):
+        return None
+    best: Optional[str] = None
+    best_step = -1
+    for name in os.listdir(save_root):
+        info = parse_ckpt_dirname(name)
+        if info is not None and info.global_step > best_step:
+            best_step = info.global_step
+            best = os.path.join(save_root, name)
+    return best

@@ -1,0 +1,48 @@
+"""Deterministic seeding across python/numpy/jax.
+
+Parity target: ``realhf/base/seeding.py`` (global seed + per-component named
+seeds). JAX is functional about randomness, so this module hands out
+``jax.random.key`` streams derived from (global seed, component name).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_SEED: int | None = None
+_EXP_NAME = ""
+_TRIAL_NAME = ""
+
+
+def set_random_seed(seed: int, key: str = "") -> None:
+    global _SEED
+    _SEED = int(seed)
+    random.seed(_mix(seed, key))
+    np.random.seed(_mix(seed, key) % (2**32))
+
+
+def get_seed() -> int:
+    if _SEED is None:
+        raise RuntimeError("set_random_seed was never called")
+    return _SEED
+
+
+def _mix(seed: int, name: str) -> int:
+    h = hashlib.blake2b(f"{seed}/{name}".encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little")
+
+
+def component_seed(name: str) -> int:
+    """A deterministic per-component integer seed."""
+    return _mix(get_seed(), name) % (2**31)
+
+
+def jax_key(name: str):
+    """A fresh jax PRNG key for a named component (lazy jax import so that
+    host-only processes never initialize a backend)."""
+    import jax
+
+    return jax.random.key(component_seed(name))

@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Mirrors the reference's CPU-only test strategy (SURVEY.md §4): all tests run
+on a virtual 8-device CPU platform so multi-chip sharding is exercised without
+TPU hardware. Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    from areal_tpu.base import seeding
+
+    seeding.set_random_seed(1)
+    np.random.seed(1)
+    yield
+
+
+@pytest.fixture()
+def tmp_name_resolve(tmp_path):
+    from areal_tpu.base import name_resolve
+
+    old = name_resolve.DEFAULT_REPO
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(str(tmp_path / "nr"))
+    yield name_resolve.DEFAULT_REPO
+    name_resolve.DEFAULT_REPO = old

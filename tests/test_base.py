@@ -1,0 +1,178 @@
+"""Tests for areal_tpu.base (datapack, timeutil, name_resolve, stats_tracker,
+recover). Mirrors the reference's tests/distributed/test_name_resolve.py and
+unit tests around datapack/freq control."""
+
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.base import datapack, name_resolve, recover, stats_tracker, timeutil
+
+
+class TestDatapack:
+    def test_contiguous_balanced_partition(self):
+        sizes = [5, 1, 1, 1, 5, 1, 1, 1, 5]
+        parts = datapack.partition_contiguous_balanced(sizes, 3)
+        assert len(parts) == 3
+        flat = [i for p in parts for i in p]
+        assert flat == list(range(len(sizes)))
+        maxsum = max(sum(sizes[i] for i in p) for p in parts)
+        assert maxsum <= 8
+
+    def test_partition_exact_groups(self):
+        for n, k in [(8, 8), (10, 3), (100, 7), (5, 1)]:
+            sizes = np.random.randint(1, 100, size=n)
+            parts = datapack.partition_contiguous_balanced(sizes, k)
+            assert len(parts) == k
+            assert all(len(p) > 0 for p in parts)
+            assert [i for p in parts for i in p] == list(range(n))
+
+    def test_ffd(self):
+        sizes = [9, 8, 2, 2, 5, 4]
+        groups = datapack.ffd_allocate(sizes, capacity=10)
+        for g in groups:
+            if len(g) > 1:
+                assert sum(sizes[i] for i in g) <= 10
+        assert sorted(i for g in groups for i in g) == list(range(len(sizes)))
+
+    def test_ffd_oversize_item(self):
+        groups = datapack.ffd_allocate([100, 1], capacity=10)
+        assert [g for g in groups if 0 in g][0] == [0]
+
+    def test_balanced_groups(self):
+        sizes = [10, 1, 1, 1, 1, 10]
+        groups = datapack.balanced_groups(sizes, 2)
+        sums = [sum(sizes[i] for i in g) for g in groups]
+        assert abs(sums[0] - sums[1]) <= 2
+
+
+class TestFreqCtl:
+    def test_step_freq(self):
+        ctl = timeutil.FrequencyControl(freq_step=3)
+        fires = [ctl.check(0, s) for s in range(1, 10)]
+        assert fires == [False, False, True, False, False, True, False, False, True]
+
+    def test_epoch_freq(self):
+        ctl = timeutil.FrequencyControl(freq_epoch=2)
+        assert not ctl.check(1, 10)
+        assert ctl.check(2, 20)
+        assert not ctl.check(3, 30)
+        assert ctl.check(4, 40)
+
+    def test_state_roundtrip(self):
+        ctl = timeutil.FrequencyControl(freq_step=5)
+        ctl.check(0, 3)
+        state = ctl.state_dict()
+        ctl2 = timeutil.FrequencyControl(freq_step=5)
+        ctl2.load_state_dict(state)
+        assert ctl2.check(0, 5) == ctl.check(0, 5)
+
+
+class TestNameResolve:
+    @pytest.mark.parametrize("repo_cls", ["memory", "nfs"])
+    def test_basic(self, repo_cls, tmp_path):
+        if repo_cls == "memory":
+            repo = name_resolve.MemoryNameRecordRepo()
+        else:
+            repo = name_resolve.NfsNameRecordRepo(str(tmp_path))
+        repo.add("a/b/c", "v1")
+        assert repo.get("a/b/c") == "v1"
+        with pytest.raises(name_resolve.NameEntryExistsError):
+            repo.add("a/b/c", "v2")
+        repo.add("a/b/c", "v2", replace=True)
+        assert repo.get("a/b/c") == "v2"
+        repo.add("a/b/d", "v3")
+        assert repo.find_subtree("a/b") == ["a/b/c", "a/b/d"]
+        assert sorted(repo.get_subtree("a/b")) == ["v2", "v3"]
+        repo.delete("a/b/c")
+        with pytest.raises(name_resolve.NameEntryNotFoundError):
+            repo.get("a/b/c")
+        repo.clear_subtree("a")
+        assert repo.find_subtree("a") == []
+
+    def test_wait(self, tmp_path):
+        repo = name_resolve.NfsNameRecordRepo(str(tmp_path))
+        import threading
+
+        def _add():
+            time.sleep(0.2)
+            repo.add("x/y", "late")
+
+        threading.Thread(target=_add).start()
+        assert repo.wait("x/y", timeout=5) == "late"
+        with pytest.raises(TimeoutError):
+            repo.wait("x/never", timeout=0.2)
+
+    def test_subentry(self, tmp_path):
+        repo = name_resolve.NfsNameRecordRepo(str(tmp_path))
+        k1 = repo.add_subentry("servers", "url1")
+        k2 = repo.add_subentry("servers", "url2")
+        assert k1 != k2
+        assert sorted(repo.get_subtree("servers")) == ["url1", "url2"]
+
+
+class TestStatsTracker:
+    def test_avg_with_denominator(self):
+        t = stats_tracker.StatsTracker()
+        mask = np.array([1, 1, 0, 0], dtype=bool)
+        vals = np.array([1.0, 3.0, 100.0, 100.0])
+        t.denominator(m=mask)
+        t.stat("m", loss=vals)
+        out = t.export()
+        assert out["loss"] == pytest.approx(2.0)
+
+    def test_scoped(self):
+        t = stats_tracker.StatsTracker()
+        with t.scope("ppo"):
+            with t.scope("actor"):
+                t.scalar(lr=0.1)
+        out = t.export()
+        assert out["ppo/actor/lr"] == pytest.approx(0.1)
+
+    def test_accumulates_across_calls(self):
+        t = stats_tracker.StatsTracker()
+        t.denominator(m=np.array([True, True]))
+        t.stat("m", x=np.array([1.0, 1.0]))
+        t.denominator(m=np.array([True, True]))
+        t.stat("m", x=np.array([3.0, 3.0]))
+        # Note second denominator replaces under same key; entries keep own ref
+        out = t.export()
+        assert out["x"] == pytest.approx(2.0)
+
+    def test_min_max(self):
+        t = stats_tracker.StatsTracker()
+        t.denominator(m=np.array([True, True, False]))
+        t.stat("m", stats_tracker.ReduceType.MAX, v=np.array([1.0, 5.0, 99.0]))
+        out = t.export()
+        assert out["v"] == pytest.approx(5.0)
+
+    def test_moving_avg(self):
+        t = stats_tracker.StatsTracker()
+        t.moving_avg(decay=0.5, tput=100.0)
+        t.moving_avg(decay=0.5, tput=200.0)
+        out = t.export()
+        assert out["tput"] == pytest.approx(150.0)
+
+
+class TestRecover:
+    def test_roundtrip(self, tmp_path):
+        info = recover.RecoverInfo(
+            recover_start=recover.StepInfo(1, 2, 3),
+            last_step_info=recover.StepInfo(1, 1, 2),
+            hash_vals_to_ignore=[123, 456],
+        )
+        recover.dump(str(tmp_path), info)
+        loaded = recover.load(str(tmp_path))
+        assert loaded.recover_start == recover.StepInfo(1, 2, 3)
+        assert loaded.hash_vals_to_ignore == [123, 456]
+
+    def test_discover_ckpt(self, tmp_path):
+        for e, es, g in [(1, 1, 1), (1, 2, 2), (2, 1, 3)]:
+            (tmp_path / recover.ckpt_dirname(e, es, g)).mkdir()
+        (tmp_path / "garbage").mkdir()
+        best = recover.discover_ckpt(str(tmp_path))
+        assert best.endswith("epoch2epochstep1globalstep3")
+
+    def test_load_missing(self, tmp_path):
+        assert recover.load(str(tmp_path / "nope")) is None
