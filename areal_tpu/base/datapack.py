@@ -102,10 +102,9 @@ def ffd_allocate(
             bins.append([i])
             loads.append(s)
     while len(bins) < min_groups and any(len(b) > 1 for b in bins):
-        # Split the heaviest multi-item bin.
-        b = max(range(len(bins)), key=lambda j: (loads[j], len(bins[j]) > 1))
-        if len(bins[b]) <= 1:
-            break
+        # Split the heaviest bin among those that can be split.
+        candidates = [j for j in range(len(bins)) if len(bins[j]) > 1]
+        b = max(candidates, key=lambda j: loads[j])
         moved = bins[b].pop()
         loads[b] -= int(sizes[moved])
         bins.append([moved])

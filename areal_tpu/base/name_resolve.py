@@ -136,20 +136,25 @@ class MemoryNameRecordRepo(NameRecordRepository):
                 raise NameEntryNotFoundError(name)
             del self._store[name]
 
+    @staticmethod
+    def _under(key: str, root: str) -> bool:
+        root = root.rstrip("/")
+        return key == root or key.startswith(root + "/")
+
     def clear_subtree(self, root):
         with self._lock:
-            for k in [k for k in self._store if k.startswith(root.rstrip("/"))]:
+            for k in [k for k in self._store if self._under(k, root)]:
                 del self._store[k]
 
     def get_subtree(self, root):
-        root = root.rstrip("/")
         with self._lock:
-            return [v for k, v in sorted(self._store.items()) if k.startswith(root)]
+            return [
+                v for k, v in sorted(self._store.items()) if self._under(k, root)
+            ]
 
     def find_subtree(self, root):
-        root = root.rstrip("/")
         with self._lock:
-            return sorted(k for k in self._store if k.startswith(root))
+            return sorted(k for k in self._store if self._under(k, root))
 
     def reset(self):
         with self._lock:

@@ -1,0 +1,83 @@
+"""Transformer configuration.
+
+Parity target: ``ReaLModelConfig`` (reference realhf/api/core/model_api.py:340)
+and the per-family HF conversion registry (realhf/api/from_hf/*.py). Families
+are expressed as pure config differences (bias flags, qk-norm, tying), not
+separate model classes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mirrors ReaLMoEConfig (reference model_api.py:294)."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    routed_intermediate_dim: Optional[int] = None
+    aux_loss_coeff: float = 1e-3
+    z_loss_coeff: float = 0.0
+    input_jitter_eps: float = 0.0
+    norm_topk_prob: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    n_layers: int
+    hidden_dim: int
+    n_q_heads: int
+    n_kv_heads: int
+    head_dim: int
+    intermediate_dim: int
+    vocab_size: int
+    rotary_base: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    use_attention_bias: bool = False  # qwen2: True on qkv
+    use_attn_output_bias: bool = False
+    use_qk_norm: bool = False  # qwen3
+    tie_word_embeddings: bool = False
+    is_critic: bool = False  # scalar head instead of lm head
+    moe: Optional[MoEConfig] = None
+    # sliding window attention (mistral/gemma2); None = full attention
+    sliding_window: Optional[int] = None
+    dtype: str = "float32"  # param dtype; compute dtype chosen at call site
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def group_size(self) -> int:
+        assert self.n_q_heads % self.n_kv_heads == 0
+        return self.n_q_heads // self.n_kv_heads
+
+
+def tiny_config(
+    vocab_size: int = 128,
+    n_layers: int = 2,
+    hidden_dim: int = 32,
+    n_q_heads: int = 4,
+    n_kv_heads: int = 2,
+    is_critic: bool = False,
+    **kw,
+) -> TransformerConfig:
+    """Small fabricated config for tests (reference testing.py:37-43)."""
+    return TransformerConfig(
+        n_layers=n_layers,
+        hidden_dim=hidden_dim,
+        n_q_heads=n_q_heads,
+        n_kv_heads=n_kv_heads,
+        head_dim=hidden_dim // n_q_heads,
+        intermediate_dim=hidden_dim * 2,
+        vocab_size=vocab_size,
+        is_critic=is_critic,
+        **kw,
+    )

@@ -1,0 +1,149 @@
+"""In-process generation engine: prefill + KV-cache decode under one jit.
+
+Parity target: the reference's in-house generation
+(``realhf/impl/model/nn/real_llm_generate.py:30,256`` — genstep + generate
+with KV cache). TPU-first differences:
+ - the whole decode loop is a single ``lax.scan`` with static shapes (no
+   CUDA-graph capture needed — XLA compiles the step once);
+ - prompts are right-padded to a bucket length, responses capped at
+   ``max_new_tokens``; finished rows keep emitting ``pad_token`` with zero
+   logprob so shapes stay static.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import forward, init_kv_cache
+from areal_tpu.ops.sampling import sample_token
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "gconfig", "max_new_tokens", "eos_token_id", "pad_token_id", "attn_impl",
+    ),
+)
+def generate_batch(
+    params,
+    cfg: TransformerConfig,
+    prompts: jnp.ndarray,  # [B, P] right-padded with pad_token
+    prompt_lens: jnp.ndarray,  # [B]
+    key: jax.Array,
+    gconfig: GenerationHyperparameters,
+    max_new_tokens: int,
+    eos_token_id: int,
+    pad_token_id: int,
+    attn_impl: str = "auto",
+) -> Dict[str, jnp.ndarray]:
+    """Returns {"output_ids": [B, N], "output_logprobs": [B, N],
+    "output_lens": [B], "prompt_logprobs": [B, P]}.
+
+    output_lens counts generated tokens incl. the EOS; slots beyond it hold
+    pad_token / 0.0 logprob.
+    """
+    B, P = prompts.shape
+    N = max_new_tokens
+    S = P + N
+
+    positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    seg = (positions < prompt_lens[:, None]).astype(jnp.int32)
+    logits, kv = forward(
+        params, cfg, prompts, positions, segment_ids=seg, attn_impl=attn_impl
+    )
+    # Log-probs of prompt tokens (teacher-forced), for optional prompt scoring.
+    lp_all = jax.nn.log_softmax(logits, axis=-1)
+    nxt = jnp.concatenate([prompts[:, 1:], prompts[:, :1]], axis=1)
+    prompt_logprobs = jnp.take_along_axis(lp_all, nxt[..., None], axis=-1)[..., 0]
+
+    # Pad per-layer KV to the full decode length.
+    kv_cache = init_kv_cache(cfg, B, S, dtype=kv["k"].dtype)
+    kv_cache = {
+        "k": jax.lax.dynamic_update_slice_in_dim(kv_cache["k"], kv["k"], 0, axis=2),
+        "v": jax.lax.dynamic_update_slice_in_dim(kv_cache["v"], kv["v"], 0, axis=2),
+    }
+
+    last_idx = jnp.maximum(prompt_lens - 1, 0)
+    last_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0]
+
+    slot_ids = jnp.arange(S)
+
+    def step(carry, n):
+        kv_cache, last_logits, finished, key = carry
+        key, sub = jax.random.split(key)
+        if gconfig.min_new_tokens > 0:
+            # Forbid EOS until min_new_tokens have been emitted (reference
+            # suppresses EOS in its logits warper the same way).
+            eos_block = (n < gconfig.min_new_tokens) & (
+                jnp.arange(last_logits.shape[-1]) == eos_token_id
+            )
+            last_logits = jnp.where(eos_block[None, :], -1e30, last_logits)
+        token, logprob = sample_token(last_logits, sub, gconfig)
+        token = jnp.where(finished, pad_token_id, token)
+        logprob = jnp.where(finished, 0.0, logprob)
+        emit_token, emit_logprob = token, logprob
+
+        pos = prompt_lens + n  # [B]
+        valid = (slot_ids[None, :] < prompt_lens[:, None]) | (
+            (slot_ids[None, :] >= P) & (slot_ids[None, :] <= P + n)
+        )
+        if cfg.sliding_window is not None:
+            # Cache slot j holds position j (prompt) or plen + (j - P) (decode).
+            slot_pos = jnp.where(
+                slot_ids[None, :] < P,
+                slot_ids[None, :],
+                prompt_lens[:, None] + (slot_ids[None, :] - P),
+            )
+            valid = valid & ((pos[:, None] - slot_pos) < cfg.sliding_window)
+        logits_step, kv_cache = forward(
+            params,
+            cfg,
+            token[:, None],
+            pos[:, None],
+            kv_cache=kv_cache,
+            cache_write_index=P + n,
+            kv_valid=valid,
+        )
+        now_finished = finished | (token == eos_token_id)
+        return (kv_cache, logits_step[:, 0], now_finished, key), (
+            emit_token,
+            emit_logprob,
+            finished,
+        )
+
+    finished0 = jnp.zeros((B,), bool)
+    (_, _, _, _), (toks, lps, was_finished) = jax.lax.scan(
+        step, (kv_cache, last_logits, finished0, key), jnp.arange(N)
+    )
+    output_ids = toks.T  # [B, N]
+    output_logprobs = lps.T
+    gen_mask = ~was_finished.T  # True where the token was actually generated
+    output_lens = gen_mask.sum(axis=1).astype(jnp.int32)
+    return {
+        "output_ids": output_ids,
+        "output_logprobs": output_logprobs.astype(jnp.float32),
+        "output_lens": output_lens,
+        "gen_mask": gen_mask,
+        "prompt_logprobs": prompt_logprobs.astype(jnp.float32),
+    }
+
+
+def pad_prompts(
+    prompt_list, pad_token_id: int, bucket: int = 64
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Right-pad a list of int lists/arrays to a bucketed max length (static
+    shapes → no recompilation churn; SURVEY §7 hard-part 6)."""
+    lens = np.array([len(p) for p in prompt_list], dtype=np.int32)
+    P = max(int(np.max(lens)), 1)
+    P = ((P + bucket - 1) // bucket) * bucket
+    out = np.full((len(prompt_list), P), pad_token_id, dtype=np.int32)
+    for i, p in enumerate(prompt_list):
+        out[i, : len(p)] = np.asarray(p, dtype=np.int32)
+    return out, lens

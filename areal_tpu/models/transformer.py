@@ -1,0 +1,242 @@
+"""The trainer transformer — pure-pytree, scan-over-layers, GSPMD-ready.
+
+Replaces the reference's ReaLModel (``realhf/impl/model/nn/real_llm_api.py:100``
++ ``real_llm_base.py``: VocabPositionEmbedding, ReaLModelBlock×L, OutputHead)
+with an idiomatic-JAX design:
+
+ - Parameters are a plain pytree with **layers stacked on a leading axis**, so
+   the forward pass is one ``lax.scan`` over layers — constant compile time in
+   depth, and pipeline parallelism can partition the stacked axis.
+ - Batches are fixed-shape ``[B, L]`` document-packed with segment ids
+   (0 = pad) instead of 1-D ragged varlen — static shapes for XLA.
+ - No module classes: ``init_params(cfg, key)`` + ``forward(params, cfg, ...)``
+   are pure functions; sharding is applied externally as a PartitionSpec tree
+   of the same structure (areal_tpu/parallel/sharding.py).
+
+Supports GQA, RoPE (HF llama-style rotate-half), RMSNorm, gated-SiLU MLP,
+optional qk-norm (qwen3), optional attention biases (qwen2), tied embeddings,
+critic (scalar) head, and a KV-cache decode mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.ops.attention import decode_attention, packed_attention
+
+Params = Dict[str, Any]
+
+
+# ---------------- init ----------------
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    n, d, dh = cfg.n_layers, cfg.hidden_dim, cfg.head_dim
+    qd, kvd, f = cfg.q_dim, cfg.kv_dim, cfg.intermediate_dim
+    keys = jax.random.split(key, 16)
+
+    def nrm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape) * scale).astype(dtype)
+
+    layers: Dict[str, jnp.ndarray] = {
+        "ln1": jnp.ones((n, d), dtype),
+        "ln2": jnp.ones((n, d), dtype),
+        "wq": nrm(keys[0], (n, d, qd)),
+        "wk": nrm(keys[1], (n, d, kvd)),
+        "wv": nrm(keys[2], (n, d, kvd)),
+        "wo": nrm(keys[3], (n, qd, d)),
+        "w_gate": nrm(keys[4], (n, d, f)),
+        "w_up": nrm(keys[5], (n, d, f)),
+        "w_down": nrm(keys[6], (n, f, d)),
+    }
+    if cfg.use_attention_bias:
+        layers["bq"] = jnp.zeros((n, qd), dtype)
+        layers["bk"] = jnp.zeros((n, kvd), dtype)
+        layers["bv"] = jnp.zeros((n, kvd), dtype)
+    if cfg.use_attn_output_bias:
+        layers["bo"] = jnp.zeros((n, d), dtype)
+    if cfg.use_qk_norm:
+        layers["q_norm"] = jnp.ones((n, dh), dtype)
+        layers["k_norm"] = jnp.ones((n, dh), dtype)
+
+    params: Params = {
+        "embedding": nrm(keys[7], (cfg.vocab_size, d)),
+        "layers": layers,
+        "final_ln": jnp.ones((d,), dtype),
+    }
+    if cfg.is_critic:
+        params["value_head"] = nrm(keys[8], (d, 1))
+    elif not cfg.tie_word_embeddings:
+        params["lm_head"] = nrm(keys[8], (d, cfg.vocab_size))
+    return params
+
+
+# ---------------- primitives ----------------
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (w * (x32 * jax.lax.rsqrt(var + eps)).astype(dt)).astype(dt)
+
+
+def rope_tables(
+    positions: jnp.ndarray, head_dim: int, base: float
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin [..., head_dim] for HF-style rotate-half RoPE."""
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., dh/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, T, H, Dh]; cos/sin: [B, T, Dh]."""
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    half = x.shape[-1] // 2
+    rot = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    return x * c + rot * s
+
+
+# ---------------- one block ----------------
+
+def _block(
+    cfg: TransformerConfig,
+    h: jnp.ndarray,  # [B, T, D]
+    lp: Dict[str, jnp.ndarray],  # this layer's params (leading axis sliced away)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    positions: Optional[jnp.ndarray],
+    cache_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],  # ([B,S,Hkv,Dh], ...)
+    cache_write_index: Optional[jnp.ndarray],
+    kv_valid: Optional[jnp.ndarray],
+    attn_impl: str,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    B, T, D = h.shape
+    dh = cfg.head_dim
+
+    x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, T, cfg.n_q_heads, dh)
+    k = k.reshape(B, T, cfg.n_kv_heads, dh)
+    v = v.reshape(B, T, cfg.n_kv_heads, dh)
+    if cfg.use_qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if cache_kv is None:
+        attn = packed_attention(
+            q, k, v, segment_ids, segment_ids,
+            q_positions=positions, kv_positions=positions,
+            causal=True, sliding_window=cfg.sliding_window, impl=attn_impl,
+        )
+        new_kv = (k, v)
+    else:
+        k_cache, v_cache = cache_kv
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k, cache_write_index, axis=1
+        )
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v, cache_write_index, axis=1
+        )
+        attn = decode_attention(q, k_cache, v_cache, kv_valid)
+        new_kv = (k_cache, v_cache)
+
+    attn = attn.reshape(B, T, cfg.q_dim) @ lp["wo"]
+    if "bo" in lp:
+        attn = attn + lp["bo"]
+    h = h + attn
+
+    x = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return h + mlp, new_kv
+
+
+# ---------------- forward ----------------
+
+def forward(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [B, T] int32
+    positions: jnp.ndarray,  # [B, T] int32 — per-sequence positions (for RoPE)
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, T], 0 = pad (packed mode)
+    kv_cache: Optional[Dict[str, jnp.ndarray]] = None,  # decode mode
+    cache_write_index: Optional[jnp.ndarray] = None,
+    kv_valid: Optional[jnp.ndarray] = None,
+    attn_impl: str = "auto",
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (output, kv) where output is logits [B, T, V] (or values [B, T]
+    for critics) and kv stacks per-layer keys/values [n_layers, B, S, Hkv, Dh]
+    (S = T in packed mode, the cache length in decode mode).
+
+    Packed mode: ``segment_ids`` given, no cache — block-causal attention.
+    Decode mode: ``kv_cache`` given — T is the new-token count (typically 1),
+    cache slots are written at ``cache_write_index`` and attention runs over
+    ``kv_valid`` cache slots.
+    """
+    h = params["embedding"][tokens]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rotary_base)
+
+    decode = kv_cache is not None
+    layer_params = params["layers"]
+
+    def body(h, xs):
+        if decode:
+            lp, (kc, vc) = xs
+            h2, (kc2, vc2) = _block(
+                cfg, h, lp, cos, sin, None, None, (kc, vc),
+                cache_write_index, kv_valid, attn_impl,
+            )
+            return h2, (kc2, vc2)
+        lp = xs
+        h2, kv = _block(
+            cfg, h, lp, cos, sin, segment_ids, positions,
+            None, None, None, attn_impl,
+        )
+        return h2, kv
+
+    if decode:
+        h, (ks, vs) = jax.lax.scan(
+            body, h, (layer_params, (kv_cache["k"], kv_cache["v"]))
+        )
+    else:
+        h, (ks, vs) = jax.lax.scan(body, h, layer_params)
+
+    h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
+    if cfg.is_critic:
+        out = (h @ params["value_head"])[..., 0]
+    elif cfg.tie_word_embeddings:
+        out = h @ params["embedding"].T
+    else:
+        out = h @ params["lm_head"]
+    return out, {"k": ks, "v": vs}
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch: int, length: int, dtype=jnp.float32
+) -> Dict[str, jnp.ndarray]:
+    shape = (cfg.n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def param_count(cfg: TransformerConfig) -> int:
+    n, d, f, v = cfg.n_layers, cfg.hidden_dim, cfg.intermediate_dim, cfg.vocab_size
+    per_layer = d * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * d + 3 * d * f + 2 * d
+    head = d * v if not (cfg.tie_word_embeddings or cfg.is_critic) else 0
+    return v * d + n * per_layer + d + head + (d if cfg.is_critic else 0)

@@ -1,0 +1,122 @@
+"""Segment-aware attention for document-packed fixed-shape batches.
+
+Replaces the reference's flash-attn varlen path
+(``realhf/impl/model/modules/attn.py:24-27``): instead of 1-D ragged batches,
+areal_tpu packs sequences into ``[B, L]`` rows with per-token segment ids
+(0 = padding) and uses block-causal same-segment masking — the layout TPU
+splash-attention kernels natively support. A Pallas flash kernel backs the
+TPU path (``areal_tpu/ops/pallas/flash_attention.py``); this module holds the
+pure-XLA reference used on CPU and for parity tests.
+
+Shapes: q ``[B, T, Hq, D]``; k, v ``[B, S, Hkv, D]`` with Hq = G * Hkv (GQA).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def segment_mask(
+    q_segment_ids: jnp.ndarray,  # [B, T] int, 0 = padding
+    kv_segment_ids: jnp.ndarray,  # [B, S]
+    q_positions: Optional[jnp.ndarray] = None,  # [B, T] global position in row
+    kv_positions: Optional[jnp.ndarray] = None,  # [B, S]
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Boolean mask [B, 1, T, S]: attend iff same (non-zero) segment and,
+    when causal, kv position <= q position (and within the sliding window
+    when one is configured: q_pos - kv_pos < window, HF mistral semantics)."""
+    same = (q_segment_ids[:, :, None] == kv_segment_ids[:, None, :]) & (
+        q_segment_ids[:, :, None] > 0
+    )
+    if causal or sliding_window is not None:
+        if q_positions is None:
+            q_positions = jnp.arange(q_segment_ids.shape[1])[None, :] * jnp.ones_like(
+                q_segment_ids
+            )
+        if kv_positions is None:
+            kv_positions = jnp.arange(kv_segment_ids.shape[1])[None, :] * jnp.ones_like(
+                kv_segment_ids
+            )
+        rel = q_positions[:, :, None] - kv_positions[:, None, :]
+        if causal:
+            same = same & (rel >= 0)
+        if sliding_window is not None:
+            same = same & (rel < sliding_window)
+    return same[:, None, :, :]
+
+
+@partial(jax.named_call, name="attention_ref")
+def attention_reference(
+    q: jnp.ndarray,  # [B, T, Hq, D]
+    k: jnp.ndarray,  # [B, S, Hkv, D]
+    v: jnp.ndarray,  # [B, S, Hkv, D]
+    mask: jnp.ndarray,  # [B, 1, T, S] bool
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    B, T, Hq, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    G = Hq // Hkv
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, T, Hkv, G, D)
+    # scores: [B, Hkv, G, T, S]
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg * scale, k)
+    m = jnp.broadcast_to(mask[:, :, None, :, :], scores.shape)
+    scores = jnp.where(m, scores, _NEG_INF)
+    # Safe softmax: rows that are fully masked (padding queries) produce zeros.
+    smax = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - jax.lax.stop_gradient(smax)) * m
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    probs = unnorm / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, Hq, D)
+
+
+def packed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_segment_ids: jnp.ndarray,
+    kv_segment_ids: jnp.ndarray,
+    q_positions: Optional[jnp.ndarray] = None,
+    kv_positions: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    sliding_window: Optional[int] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Dispatch between the XLA reference and the Pallas TPU kernel."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+    if impl == "pallas" and sliding_window is None:
+        try:
+            from areal_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, q_segment_ids, kv_segment_ids,
+                q_positions=q_positions, kv_positions=kv_positions, causal=causal,
+            )
+        except (ImportError, NotImplementedError):
+            pass
+    mask = segment_mask(
+        q_segment_ids, kv_segment_ids, q_positions, kv_positions, causal,
+        sliding_window=sliding_window,
+    )
+    return attention_reference(q, k, v, mask)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, 1, Hq, D] — current step
+    k_cache: jnp.ndarray,  # [B, S, Hkv, D]
+    v_cache: jnp.ndarray,  # [B, S, Hkv, D]
+    kv_valid: jnp.ndarray,  # [B, S] bool — which cache slots are real tokens
+) -> jnp.ndarray:
+    mask = kv_valid[:, None, None, :]  # [B, 1, 1, S]
+    return attention_reference(q, k_cache, v_cache, mask)

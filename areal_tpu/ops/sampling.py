@@ -1,0 +1,64 @@
+"""Token sampling: temperature / top-k / top-p logits warping.
+
+Parity target: ``realhf/impl/model/utils/logits_warper.py`` + genstep
+(``realhf/impl/model/nn/real_llm_generate.py:30``). All ops are vectorized
+over the batch and jit-safe (static top_k).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.model import GenerationHyperparameters
+
+_NEG_INF = -1e30
+
+
+def apply_temperature(logits: jnp.ndarray, temperature: float) -> jnp.ndarray:
+    return logits / jnp.maximum(temperature, 1e-6)
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens while cumulative prob (exclusive) < p: always keep the top-1.
+    keep_sorted = (cum - probs) < p
+    cutoff = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # number kept
+    kth = jnp.take_along_axis(sorted_logits, cutoff - 1, axis=-1)
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def warp_logits(logits: jnp.ndarray, g: GenerationHyperparameters) -> jnp.ndarray:
+    logits = apply_temperature(logits, g.temperature)
+    logits = apply_top_k(logits, g.top_k)
+    logits = apply_top_p(logits, g.top_p)
+    return logits
+
+
+def sample_token(
+    logits: jnp.ndarray,  # [B, V] raw logits
+    key: jax.Array,
+    g: GenerationHyperparameters,
+):
+    """Returns (tokens [B], logprobs [B]) — logprob of the sampled token under
+    the *warped* distribution (what the behavior policy actually sampled from;
+    reference genstep records these as packed_logprobs)."""
+    warped = warp_logits(logits, g)
+    logp = jax.nn.log_softmax(warped, axis=-1)
+    if g.greedy:
+        tokens = jnp.argmax(warped, axis=-1)
+    else:
+        tokens = jax.random.categorical(key, warped, axis=-1)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens.astype(jnp.int32), chosen

@@ -176,3 +176,9 @@ class TestRecover:
 
     def test_load_missing(self, tmp_path):
         assert recover.load(str(tmp_path / "nope")) is None
+
+
+class TestFFDMinGroups:
+    def test_min_groups_splits_multi_item_bins(self):
+        groups = datapack.ffd_allocate([10, 3, 3], capacity=10, min_groups=3)
+        assert len(groups) == 3

@@ -1,0 +1,96 @@
+"""DFG construction tests — mirrors the reference's tests/data/test_dfg.py.
+Builds the 7-node PPO graph shape from SURVEY.md §2.10."""
+
+import pytest
+
+from areal_tpu.api.dfg import (
+    MFCDef,
+    MFCInterfaceType,
+    ModelInterfaceAbstraction,
+    build_graph,
+)
+
+
+def mfc(name, model, itype, inputs, outputs, **kw):
+    return MFCDef(
+        name=name,
+        model_name=model,
+        interface_type=itype,
+        interface_impl=ModelInterfaceAbstraction("null"),
+        input_keys=tuple(inputs),
+        output_keys=tuple(outputs),
+        **kw,
+    )
+
+
+def ppo_nodes():
+    G = MFCInterfaceType.GENERATE
+    I = MFCInterfaceType.INFERENCE
+    T = MFCInterfaceType.TRAIN_STEP
+    return [
+        mfc("actor_gen", "actor", G, ["packed_prompts"], ["packed_input_ids", "packed_logprobs", "prompt_mask"]),
+        mfc("actor_inf", "actor", I, ["packed_input_ids"], ["proximal_logprobs"]),
+        mfc("rew_inf", "reward", I, ["packed_input_ids"], ["rewards"]),
+        mfc("ref_inf", "ref", I, ["packed_input_ids"], ["packed_ref_logprobs"]),
+        mfc("critic_inf", "critic", I, ["packed_input_ids"], ["values"]),
+        mfc(
+            "actor_train", "actor", T,
+            ["packed_input_ids", "packed_logprobs", "proximal_logprobs", "rewards", "packed_ref_logprobs", "values", "prompt_mask"],
+            [],
+        ),
+        mfc(
+            "critic_train", "critic", T,
+            ["packed_input_ids", "rewards", "values", "packed_ref_logprobs", "prompt_mask", "packed_logprobs"],
+            [],
+        ),
+    ]
+
+
+class TestBuildGraph:
+    def test_ppo_graph_edges(self):
+        g = build_graph(ppo_nodes())
+        gen = g.nodes["actor_gen"]
+        assert gen.is_src
+        assert set(gen.children) == {
+            "actor_inf", "rew_inf", "ref_inf", "critic_inf", "actor_train", "critic_train",
+        }
+        at = g.nodes["actor_train"]
+        assert at.is_dst
+        assert set(at.parents) == {
+            "actor_gen", "actor_inf", "rew_inf", "ref_inf", "critic_inf",
+        }
+
+    def test_topological_order(self):
+        g = build_graph(ppo_nodes())
+        order = g.topological_order()
+        assert order[0] == "actor_gen"
+        assert set(order[-2:]) == {"actor_train", "critic_train"}
+
+    def test_source_keys_are_dataset_keys(self):
+        g = build_graph(ppo_nodes())
+        assert g.source_keys == {"packed_prompts"}
+
+    def test_duplicate_producer_rejected(self):
+        nodes = ppo_nodes()
+        nodes.append(
+            mfc("rew_inf2", "reward", MFCInterfaceType.INFERENCE, ["packed_input_ids"], ["rewards"])
+        )
+        with pytest.raises(ValueError):
+            build_graph(nodes)
+
+    def test_cycle_detection(self):
+        a = mfc("a", "m", MFCInterfaceType.INFERENCE, ["y"], ["x"])
+        b = mfc("b", "m", MFCInterfaceType.INFERENCE, ["x"], ["y"])
+        with pytest.raises(ValueError):
+            build_graph([a, b])
+
+    def test_output_remap_feeds_consumer(self):
+        a = mfc("a", "m", MFCInterfaceType.INFERENCE, ["p"], ["raw"],
+                output_key_remap={"raw": "cooked"})
+        b = mfc("b", "m", MFCInterfaceType.TRAIN_STEP, ["cooked"], [])
+        g = build_graph([a, b])
+        assert g.nodes["b"].parents == ["a"]
+
+    def test_model_names(self):
+        g = build_graph(ppo_nodes())
+        assert g.model_names == {"actor", "critic", "ref", "reward"}

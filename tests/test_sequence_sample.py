@@ -1,0 +1,136 @@
+"""SequenceSample invariants — mirrors the reference's
+tests/data/test_sequence_gather_split.py."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+
+
+def make_sample(bs=6, seed=0):
+    rng = np.random.default_rng(seed)
+    seqlens = rng.integers(3, 17, size=bs).tolist()
+    total = sum(seqlens)
+    data = {
+        "packed_input_ids": rng.integers(0, 100, size=total).astype(np.int32),
+        "rewards": rng.normal(size=bs).astype(np.float32),
+    }
+    ids = [f"s{i}" for i in range(bs)]
+    return SequenceSample.from_default(ids, data, seqlens), seqlens
+
+
+class TestConstruction:
+    def test_from_default_infers_seqlens(self):
+        s, seqlens = make_sample()
+        assert s.seqlens["packed_input_ids"] == [[x] for x in seqlens]
+        assert s.seqlens["rewards"] == [[1]] * s.bs
+
+    def test_duplicate_ids_rejected(self):
+        with pytest.raises(ValueError):
+            SequenceSample(
+                ids=["a", "a"],
+                keys={"x"},
+                seqlens={"x": [[1], [1]]},
+                data={"x": np.zeros(2)},
+            )
+
+    def test_bad_data_length_rejected(self):
+        with pytest.raises(ValueError):
+            SequenceSample(
+                ids=["a"],
+                keys={"x"},
+                seqlens={"x": [[3]]},
+                data={"x": np.zeros(2)},
+            )
+
+
+class TestSplitGather:
+    def test_split_gather_roundtrip(self):
+        s, _ = make_sample(bs=8)
+        parts, groups = s.split(k=3)
+        regathered = SequenceSample.gather(parts)
+        # Order may differ; select back to original order and compare.
+        back = regathered.select_ids(s.ids)
+        np.testing.assert_array_equal(
+            back.data["packed_input_ids"], s.data["packed_input_ids"]
+        )
+        np.testing.assert_array_equal(back.data["rewards"], s.data["rewards"])
+        assert back.seqlens == s.seqlens
+
+    def test_split_balanced(self):
+        s, seqlens = make_sample(bs=16)
+        parts, groups = s.split(k=4)
+        sums = [sum(sum(x) for x in p.seqlens["packed_input_ids"]) for p in parts]
+        assert max(sums) - min(sums) <= max(seqlens)
+
+    def test_split_mb_spec_token_cap(self):
+        s, _ = make_sample(bs=10)
+        parts, _ = s.split(mb_spec=MicroBatchSpec(n_mbs=1, max_tokens_per_mb=32))
+        for p in parts:
+            if p.bs > 1:
+                assert p.total_lens().sum() <= 32
+
+    def test_select_idx_slices_all_keys(self):
+        s, seqlens = make_sample(bs=5)
+        sub = s.select_idx([1, 3])
+        assert sub.ids == ["s1", "s3"]
+        assert sub.data["packed_input_ids"].shape[0] == seqlens[1] + seqlens[3]
+        assert sub.data["rewards"].shape[0] == 2
+
+    def test_meta_drops_data(self):
+        s, _ = make_sample()
+        m = s.meta()
+        assert m.data is None
+        assert m.keys == s.keys
+        # meta split still works (master-side dispatch is metadata-only)
+        parts, _ = m.split(k=2)
+        assert sum(p.bs for p in parts) == s.bs
+
+
+class TestUpdateRemap:
+    def test_update_merges_new_keys(self):
+        s, seqlens = make_sample(bs=4)
+        other = SequenceSample.from_default(
+            ids=list(reversed(s.ids)),
+            data={"logprobs": np.arange(sum(seqlens), dtype=np.float32)},
+            seqlens=list(reversed(seqlens)),
+        )
+        s.update_(other)
+        assert "logprobs" in s.keys
+        # update_ reorders `other` to self's id order
+        assert s.seqlens["logprobs"] == [[x] for x in seqlens]
+
+    def test_remap(self):
+        s, _ = make_sample()
+        s.remap_keys_({"rewards": "scores"})
+        assert "scores" in s.keys and "rewards" not in s.keys
+
+
+class TestCodec:
+    def test_json_roundtrip(self):
+        s, _ = make_sample()
+        s.metadata["birth_time"] = [0.5] * s.bs
+        d = s.as_json_compatible()
+        import json
+
+        s2 = SequenceSample.from_json_compatible(json.loads(json.dumps(d)))
+        np.testing.assert_array_equal(
+            s2.data["packed_input_ids"], s.data["packed_input_ids"]
+        )
+        assert s2.data["packed_input_ids"].dtype == np.int32
+        assert s2.metadata["birth_time"] == s.metadata["birth_time"]
+
+    def test_cu_seqlens(self):
+        s, seqlens = make_sample(bs=3)
+        cu = s.cu_seqlens()
+        np.testing.assert_array_equal(cu, np.concatenate([[0], np.cumsum(seqlens)]))
+
+
+def test_split_k_greater_than_bs_returns_exactly_k():
+    s, _ = make_sample(bs=2)
+    parts, groups = s.split(k=4)
+    assert len(parts) == 4
+    assert sum(p.bs for p in parts) == 2
+    empty = [p for p in parts if p.bs == 0]
+    assert len(empty) == 2
+    assert SequenceSample.gather(parts).bs == 2
