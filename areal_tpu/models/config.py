@@ -19,6 +19,8 @@ class MoEConfig:
     num_experts: int = 8
     top_k: int = 2
     routed_intermediate_dim: Optional[int] = None
+    # qwen-moe style always-on shared expert; None = no shared expert
+    shared_intermediate_dim: Optional[int] = None
     aux_loss_coeff: float = 1e-3
     z_loss_coeff: float = 0.0
     input_jitter_eps: float = 0.0

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import decode_attention, packed_attention
+from areal_tpu.parallel.sharding import constrain
 
 Params = Dict[str, Any]
 
@@ -34,6 +35,10 @@ Params = Dict[str, Any]
 # ---------------- init ----------------
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "MoE layers are built by areal_tpu.models.moe (pending); dense only"
+        )
     dtype = jnp.dtype(cfg.dtype)
     n, d, dh = cfg.n_layers, cfg.hidden_dim, cfg.head_dim
     qd, kvd, f = cfg.q_dim, cfg.kv_dim, cfg.intermediate_dim
@@ -158,14 +163,15 @@ def _block(
         attn = decode_attention(q, k_cache, v_cache, kv_valid)
         new_kv = (k_cache, v_cache)
 
+    hid = "hidden" if cache_kv is None else "hidden_decode"
     attn = attn.reshape(B, T, cfg.q_dim) @ lp["wo"]
     if "bo" in lp:
         attn = attn + lp["bo"]
-    h = h + attn
+    h = constrain(h + attn, hid)
 
     x = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
     mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-    return h + mlp, new_kv
+    return constrain(h + mlp, hid), new_kv
 
 
 # ---------------- forward ----------------
@@ -190,10 +196,9 @@ def forward(
     cache slots are written at ``cache_write_index`` and attention runs over
     ``kv_valid`` cache slots.
     """
-    h = params["embedding"][tokens]
-    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rotary_base)
-
     decode = kv_cache is not None
+    h = constrain(params["embedding"][tokens], "hidden" if not decode else "hidden_decode")
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rotary_base)
     layer_params = params["layers"]
 
     def body(h, xs):
@@ -219,12 +224,13 @@ def forward(
         h, (ks, vs) = jax.lax.scan(body, h, layer_params)
 
     h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
+    lg = "logits" if not decode else "logits_decode"
     if cfg.is_critic:
         out = (h @ params["value_head"])[..., 0]
     elif cfg.tie_word_embeddings:
-        out = h @ params["embedding"].T
+        out = constrain(h @ params["embedding"].T, lg)
     else:
-        out = h @ params["lm_head"]
+        out = constrain(h @ params["lm_head"], lg)
     return out, {"k": ks, "v": vs}
 
 

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+_WARNED_FALLBACK = False
 
 
 def segment_mask(
@@ -93,6 +94,7 @@ def packed_attention(
     impl: str = "auto",
 ) -> jnp.ndarray:
     """Dispatch between the XLA reference and the Pallas TPU kernel."""
+    explicit = impl == "pallas"
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
     if impl == "pallas" and sliding_window is None:
@@ -103,8 +105,18 @@ def packed_attention(
                 q, k, v, q_segment_ids, kv_segment_ids,
                 q_positions=q_positions, kv_positions=kv_positions, causal=causal,
             )
-        except (ImportError, NotImplementedError):
-            pass
+        except (ImportError, NotImplementedError) as e:
+            if explicit:
+                raise
+            global _WARNED_FALLBACK
+            if not _WARNED_FALLBACK:
+                _WARNED_FALLBACK = True
+                import logging
+
+                logging.getLogger("areal_tpu").warning(
+                    "pallas flash attention unavailable (%s); falling back to "
+                    "the O(S^2) XLA reference", e,
+                )
     mask = segment_mask(
         q_segment_ids, kv_segment_ids, q_positions, kv_positions, causal,
         sliding_window=sliding_window,

@@ -1,0 +1,96 @@
+"""Mesh / sharding tests on the virtual 8-device CPU platform.
+
+Mirrors the role of the reference's multi-process CPU comm tests
+(tests/comm/, SURVEY.md §4) — but GSPMD needs no processes: correctness is
+(a) spec parsing, (b) sharded forward == single-device forward, (c) grads
+flow under sharding constraints.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.parallel import mesh as pmesh
+from areal_tpu.parallel import sharding as psh
+
+
+def test_parallel_spec_parse():
+    s = pmesh.ParallelSpec.parse("d2t4")
+    assert (s.dp, s.tp) == (2, 4) and s.world_size == 8
+    s = pmesh.ParallelSpec.parse("d2f2s2t1")
+    assert (s.dp, s.fsdp, s.sp, s.tp) == (2, 2, 2, 1)
+    # reference spelling: m = model(tensor) parallel
+    s = pmesh.ParallelSpec.parse("d4p2m1")
+    assert (s.dp, s.pp, s.tp) == (4, 2, 1)
+    with pytest.raises(ValueError):
+        pmesh.ParallelSpec.parse("d2d4")
+    with pytest.raises(ValueError):
+        pmesh.ParallelSpec.parse("x3")
+
+
+def test_allocation_mode_parse():
+    am = pmesh.AllocationMode.parse("d2t2")
+    assert not am.decoupled and am.global_spec.tp == 2
+    am = pmesh.AllocationMode.parse("gen.d4+train.d2t2")
+    assert am.decoupled and am.gen_spec.dp == 4 and am.global_spec.tp == 2
+    am = pmesh.AllocationMode.parse("sglang.d4m1p1+d2m2p2")
+    assert am.decoupled and am.gen_spec.dp == 4
+    assert am.global_spec.tp == 2 and am.global_spec.pp == 2
+    am = pmesh.AllocationMode.parse("actor_gen:d4t2,actor_train:f4t2")
+    assert am.per_mfc["actor_gen"].dp == 4 and am.global_spec.fsdp == 4
+
+
+def test_make_mesh_axes():
+    m = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
+    assert m.axis_names == pmesh.AXIS_ORDER
+    assert m.shape["dp"] == 2 and m.shape["fsdp"] == 2 and m.shape["tp"] == 2
+    assert m.shape["pp"] == 1 and m.shape["sp"] == 1
+
+
+@pytest.mark.parametrize("spec_str", ["d2f2t2", "d1f2s2t2", "f2t4"])
+def test_sharded_forward_matches_single_device(spec_str):
+    cfg = tiny_config(n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+    B, T = 4, 16
+    tokens = np.random.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    positions = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    seg = np.ones((B, T), np.int32)
+    ref, _ = transformer.forward(params, cfg, tokens, positions, segment_ids=seg)
+
+    spec = pmesh.ParallelSpec.parse(spec_str)
+    m = pmesh.make_mesh(spec)
+    sp = psh.shard_params(params, m, cfg)
+    shardings = psh.named_shardings(m, psh.param_partition_specs(cfg))
+    # Every param leaf must have been placed with its spec.
+    jax.tree.map(lambda x, s: x.sharding == s or pytest.fail(), sp, shardings)
+
+    def fwd(p, t, pos, s):
+        with psh.activation_sharding(m):
+            out, _ = transformer.forward(p, cfg, t, pos, segment_ids=s)
+        return out
+
+    out = jax.jit(fwd)(sp, tokens, positions, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_sharded_grad_runs():
+    cfg = tiny_config()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    m = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
+    sp = psh.shard_params(params, m, cfg)
+    B, T = 4, 8
+    tokens = jnp.zeros((B, T), jnp.int32)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32), (B, 1))
+    seg = jnp.ones((B, T), jnp.int32)
+
+    def loss(p):
+        with psh.activation_sharding(m):
+            logits, _ = transformer.forward(p, cfg, tokens, positions, segment_ids=seg)
+        return jnp.mean(logits**2)
+
+    g = jax.jit(jax.grad(loss))(sp)
+    assert jnp.isfinite(jax.tree.reduce(lambda a, b: a + jnp.sum(b), g, 0.0))
