@@ -1,0 +1,300 @@
+"""PPO math: losses, GAE, KL controllers, reward shaping, normalization.
+
+Parity targets:
+ - ``realhf/impl/model/utils/ppo_functional.py`` — ``actor_loss_fn:51``
+   (decoupled clip center + behaviour importance weight cap + dual clip),
+   ``critic_loss_fn:161``, KL controllers ``:14-48``, reward shaping
+   ``:229-291``, python GAE ``:292``;
+ - ``csrc/cugae/gae.cu:10`` (``gae_1d_nolp_misalign``) — here a segment-aware
+   reversed ``lax.associative_scan`` over the fixed [B, L] grid: the linear
+   recurrence ``adv_t = δ_t + γλ·adv_{t+1}`` is associative, so the whole GAE
+   is one O(log L) scan on the VPU instead of a per-sequence CUDA thread loop;
+ - ``realhf/impl/model/utils/functional.py`` — masked normalization,
+   gather of shifted logprobs.
+
+Everything operates on the [B, L] grid with a boolean ``mask`` (True = real
+token position that contributes); host-side numpy references live next to
+each jax function for kernel-parity tests (mirroring tests/cpp_extensions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------- logprob gathering ----------------
+
+def gather_logprobs(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """log p(labels) per position. logits [B, L, V], labels [B, L] → [B, L].
+
+    Equivalent of gather_packed_shifted_log_probs (reference functional.py);
+    the shift is the caller's responsibility (labels[t] = token at t+1).
+    """
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+
+
+def masked_normalization(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float = 1e-5,
+    high_precision: bool = True,
+    reduce_group_axes: Optional[tuple] = None,
+) -> jnp.ndarray:
+    """Whiten x over masked entries (reference functional.py masked_normalization).
+
+    ``reduce_group_axes``: mesh axis names to psum over when called inside
+    shard_map (the reference all-reduces over the DP group); under plain GSPMD
+    jit the global mean is already global, so the default needs no collectives.
+    """
+    dt = jnp.float64 if high_precision and jax.config.jax_enable_x64 else jnp.float32
+    x32 = x.astype(dt)
+    m = mask.astype(dt)
+    cnt = jnp.sum(m)
+    ssum = jnp.sum(x32 * m)
+    if reduce_group_axes:
+        cnt = jax.lax.psum(cnt, reduce_group_axes)
+        ssum = jax.lax.psum(ssum, reduce_group_axes)
+    mean = ssum / jnp.maximum(cnt, 1.0)
+    var_sum = jnp.sum(((x32 - mean) ** 2) * m)
+    if reduce_group_axes:
+        var_sum = jax.lax.psum(var_sum, reduce_group_axes)
+    var = var_sum / jnp.maximum(cnt, 1.0)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps) * m).astype(x.dtype)
+
+
+# ---------------- GAE ----------------
+
+def gae_grid(
+    rewards: jnp.ndarray,  # [B, L] per-token rewards
+    values: jnp.ndarray,  # [B, L] V(s_t) under the same layout
+    segment_ids: jnp.ndarray,  # [B, L] int, 0 = pad — document boundaries
+    bootstrap: Optional[jnp.ndarray] = None,  # [B, L] V(s_{t+1}) at seq ends
+    gamma: float = 1.0,
+    lam: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Segment-aware GAE on the fixed grid; returns (advantages, returns).
+
+    Documents are contiguous same-id runs of ``segment_ids`` within a row
+    (the packing.py layout). δ_t = r_t + γ·V_{t+1} − V_t with V beyond the
+    document end = 0 (truncated sequences can pass ``bootstrap`` holding
+    V(s_{T}) at the last token). adv_t = δ_t + γλ·adv_{t+1}, reset across
+    document boundaries.
+    """
+    f32 = jnp.float32
+    mask = segment_ids > 0
+    r = rewards.astype(f32)
+    v = values.astype(f32) * mask
+    # "continues": position t+1 exists and belongs to the same document.
+    nxt_seg = jnp.concatenate(
+        [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+    )
+    continues = (nxt_seg == segment_ids) & mask
+    # V_{t+1}: next value within the same document, else bootstrap (default 0).
+    v_next = jnp.concatenate([v[:, 1:], jnp.zeros_like(v[:, :1])], axis=1)
+    v_next = jnp.where(continues, v_next, 0.0)
+    if bootstrap is not None:
+        last = mask & ~continues
+        v_next = jnp.where(last, bootstrap.astype(f32), v_next)
+    delta = (r + gamma * v_next - v) * mask
+
+    # adv_t = δ_t + a_t · adv_{t+1},  a_t = γλ where t+1 continues the doc.
+    a = (gamma * lam) * continues.astype(f32)
+
+    # Reversed associative scan of the linear recurrence (y, pairs combine as
+    # (a1·a2, b2 + a2·b1) in scan order; we scan the time-reversed arrays).
+    def combine(x, y):
+        ax, bx = x
+        ay, by = y
+        return ax * ay, by + ay * bx
+
+    a_rev = a[:, ::-1]
+    d_rev = delta[:, ::-1]
+    _, adv_rev = jax.lax.associative_scan(combine, (a_rev, d_rev), axis=1)
+    adv = adv_rev[:, ::-1] * mask
+    return adv, adv + v
+
+
+def gae_packed_np(
+    rewards: np.ndarray,  # 1-D packed over sequences
+    values: np.ndarray,  # 1-D packed, same layout
+    seqlens,  # per-sequence lengths
+    bootstrap: Optional[np.ndarray] = None,  # [n_seqs] V at truncation, 0 if done
+    gamma: float = 1.0,
+    lam: float = 1.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy reference for 1-D packed GAE — parity with the reference's
+    ``pygae1d_nolp_misalign`` (ppo_functional.py:292) / ``gae.cu:10``."""
+    adv = np.zeros_like(values, dtype=np.float64)
+    ret = np.zeros_like(values, dtype=np.float64)
+    off = 0
+    for i, n in enumerate(seqlens):
+        n = int(n)
+        acc = 0.0
+        vnext = float(bootstrap[i]) if bootstrap is not None else 0.0
+        for t in range(n - 1, -1, -1):
+            delta = rewards[off + t] + gamma * vnext - values[off + t]
+            acc = delta + gamma * lam * acc
+            adv[off + t] = acc
+            ret[off + t] = acc + values[off + t]
+            vnext = values[off + t]
+        off += n
+    return adv.astype(np.float32), ret.astype(np.float32)
+
+
+# ---------------- losses ----------------
+
+def actor_loss(
+    logprobs: jnp.ndarray,  # [B, L] π_θ logprobs of taken actions
+    old_logprobs: jnp.ndarray,  # [B, L] behaviour policy (sampler) logprobs
+    advantages: jnp.ndarray,  # [B, L]
+    mask: jnp.ndarray,  # [B, L] bool
+    eps_clip: float = 0.2,
+    c_clip: Optional[float] = None,  # dual clip (> 1.0) for negative adv
+    proximal_logprobs: Optional[jnp.ndarray] = None,  # decoupled clip center
+    behav_imp_weight_cap: Optional[float] = None,
+    loss_scale: Optional[jnp.ndarray] = None,  # denominator; default masked count
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Decoupled PPO actor loss (reference ppo_functional.py:51-158).
+
+    With ``proximal_logprobs`` (π_prox, recomputed at train time), the clip
+    ratio is centered on π_prox and the whole term is multiplied by the
+    behaviour importance weight exp(π_prox − π_behav) (optionally capped) —
+    the AReaL decoupled-loss objective that keeps training stable at high
+    staleness. Without it, this reduces to standard PPO.
+    """
+    mask = mask.astype(jnp.bool_)
+    denom = jnp.maximum(
+        loss_scale if loss_scale is not None else jnp.sum(mask), 1.0
+    )
+    center = proximal_logprobs if proximal_logprobs is not None else old_logprobs
+    ratio = jnp.exp(jnp.where(mask, logprobs - center, 0.0))
+    clipped = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+    l1 = -advantages * ratio
+    l2 = -advantages * clipped
+    loss_tok = jnp.maximum(l1, l2)
+    clip_mask = (l2 > l1) & mask
+    if c_clip is not None:
+        assert c_clip > 1.0
+        l3 = -advantages * c_clip
+        dual_mask = (advantages < 0) & mask
+        dual = jnp.minimum(loss_tok, l3)
+        dual_clip_mask = (l3 < loss_tok) & dual_mask
+        loss_tok = jnp.where(dual_mask, dual, loss_tok)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+    if proximal_logprobs is not None:
+        behav_w = jnp.exp(jnp.where(mask, center - old_logprobs, 0.0))
+        if behav_imp_weight_cap is not None:
+            # Reference drops tokens whose weight exceeds the cap.
+            keep = behav_w <= behav_imp_weight_cap
+            behav_w = jnp.where(keep, behav_w, 0.0)
+        loss_tok = loss_tok * behav_w
+    loss = jnp.sum(jnp.where(mask, loss_tok, 0.0)) / denom
+    stats = {
+        "importance_weight": jnp.sum(ratio * mask) / denom,
+        "clip_ratio": jnp.sum(clip_mask) / denom,
+        "dual_clip_ratio": jnp.sum(dual_clip_mask) / denom,
+    }
+    return loss, stats
+
+
+def critic_loss(
+    value: jnp.ndarray,  # [B, L] new value prediction
+    old_value: jnp.ndarray,  # [B, L] value at rollout time
+    returns: jnp.ndarray,  # [B, L] GAE returns (target)
+    mask: jnp.ndarray,
+    value_eps_clip: float = 0.2,
+    loss_fn: str = "huber",
+    huber_delta: float = 10.0,
+    loss_scale: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped value loss (reference ppo_functional.py:161-228; huber delta
+    defaults to the reference's 10.0)."""
+    mask = mask.astype(jnp.bool_)
+    denom = jnp.maximum(
+        loss_scale if loss_scale is not None else jnp.sum(mask), 1.0
+    )
+
+    if loss_fn == "huber":
+        def base(x, y):
+            d = jnp.abs(x - y)
+            return jnp.where(
+                d < huber_delta, 0.5 * d * d, huber_delta * (d - 0.5 * huber_delta)
+            )
+    else:
+        def base(x, y):
+            return 0.5 * (x - y) ** 2
+
+    clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    l1 = base(value, returns)
+    l2 = base(clipped, returns)
+    clip_mask = (l2 > l1) & mask
+    loss_tok = jnp.maximum(l1, l2)
+    loss = jnp.sum(jnp.where(mask, loss_tok, 0.0)) / denom
+    return loss, {"value_clip_ratio": jnp.sum(clip_mask) / denom}
+
+
+# ---------------- KL & rewards ----------------
+
+@dataclasses.dataclass
+class FixedKLController:
+    """Reference ppo_functional.py:37-48."""
+
+    kl_coef: float = 0.0
+
+    @property
+    def value(self) -> float:
+        return self.kl_coef
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class AdaptiveKLController:
+    """Reference ppo_functional.py:14-36 (Ziegler et al. adaptive KL)."""
+
+    init_kl_coef: float
+    target: float
+    horizon: float
+    _value: float = dataclasses.field(default=0.0, init=False)
+
+    def __post_init__(self):
+        self._value = self.init_kl_coef
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def update(self, current_kl: float, n_steps: int) -> None:
+        err = np.clip(current_kl / self.target - 1.0, -0.2, 0.2)
+        self._value *= 1.0 + err * n_steps / self.horizon
+
+
+def shape_rewards(
+    score: jnp.ndarray,  # [B] scalar task reward per sequence (row-major seq order)
+    kl: jnp.ndarray,  # [B, L] per-token KL(π_behav ‖ π_ref) estimate
+    mask: jnp.ndarray,  # [B, L] action-token mask
+    last_token_idx: jnp.ndarray,  # [B] grid column of each sequence's last token
+    row_idx: jnp.ndarray,  # [B] grid row of each sequence
+    kl_coef: float,
+    reward_scaling: float = 1.0,
+    reward_bias: float = 0.0,
+    clip: float = 20.0,
+) -> jnp.ndarray:
+    """Sparse reward shaping (reference ppo_functional.py:229-263): the task
+    score lands on each sequence's final token; −kl_coef·KL everywhere."""
+    tok_score = jnp.clip(
+        (score - reward_bias) * reward_scaling, -clip, clip
+    )
+    rewards = -kl_coef * kl * mask
+    rewards = rewards.at[row_idx, last_token_idx].add(tok_score)
+    return rewards

@@ -1,0 +1,388 @@
+"""The optax/GSPMD training engine — areal_tpu's Megatron-backend equivalent.
+
+Parity target: ``realhf/impl/model/backend/megatron.py`` (ReaLMegatronEngine:
+microbatched train_batch/forward/generate with global token normalization,
+grad-norm stats, lr scheduling) and ``inference.py`` (PipelinableInference-
+Engine). TPU-first differences:
+
+ - No DDP/ZeRO wrapper classes: params/opt-state sharding IS the
+   PartitionSpec tree (parallel/sharding.py); XLA emits the reduce-scatters
+   Megatron's DistributedOptimizer hand-codes.
+ - No pipeline-schedule VM (instruction.py/pipe_runner.py): micro-batches
+   exist only to bound activation HBM; each one is a full jitted step and
+   gradients accumulate across them on device.
+ - Mixed precision: params live in f32 (or cfg dtype), compute is cast per
+   step to ``compute_dtype`` (bf16 on the MXU); no loss scaling needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import (
+    FinetuneSpec,
+    GenerationHyperparameters,
+    Model,
+    ModelBackend,
+    TrainableEngine,
+    register_backend,
+)
+from areal_tpu.backend import microbatch as mbu
+from areal_tpu.base import logging
+from areal_tpu.models import generate as genmod
+from areal_tpu.models import transformer
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.parallel import sharding as psh
+
+logger = logging.getLogger("backend.jax")
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Reference cli_args.py:173 (OptimizerConfig)."""
+
+    type: str = "adamw"
+    lr: float = 1e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    warmup_steps_proportion: float = 0.02
+    lr_scheduler_type: str = "constant"  # constant | cosine | linear
+    gradient_clipping: float = 1.0
+
+
+def build_lr_schedule(cfg: OptimizerConfig, total_steps: int):
+    """Warmup + {constant,cosine,linear} decay to min_lr_ratio·lr (parity:
+    thirdparty/megatron lr_schduler.py used by the reference backend)."""
+    total_steps = max(total_steps, 1)
+    warmup = int(cfg.warmup_steps_proportion * total_steps)
+    floor = cfg.lr * cfg.min_lr_ratio
+    if cfg.lr_scheduler_type == "cosine":
+        decay = optax.cosine_decay_schedule(
+            cfg.lr, max(total_steps - warmup, 1), alpha=cfg.min_lr_ratio
+        )
+    elif cfg.lr_scheduler_type == "linear":
+        decay = optax.linear_schedule(
+            cfg.lr, floor, max(total_steps - warmup, 1)
+        )
+    else:
+        decay = optax.constant_schedule(cfg.lr)
+    if warmup > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, cfg.lr, warmup), decay], [warmup]
+        )
+    return decay
+
+
+def build_optimizer(
+    cfg: OptimizerConfig, total_steps: int
+) -> Tuple[optax.GradientTransformation, Callable]:
+    sched = build_lr_schedule(cfg, total_steps)
+    assert cfg.type in ("adamw", "sgd"), cfg.type
+    if cfg.type == "adamw":
+        opt = optax.adamw(
+            sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+        )
+    else:
+        opt = optax.sgd(sched)
+    chain = [opt]
+    if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+        chain = [optax.clip_by_global_norm(cfg.gradient_clipping)] + chain
+    return optax.chain(*chain), sched
+
+
+# Loss functions receive (logits, batch) and return (loss_sum, stats-sums).
+LossFn = Callable[[jnp.ndarray, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]]
+
+
+class JaxTrainEngine(TrainableEngine):
+    """Owns (params, opt_state) on an optional mesh and the jitted steps."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Any,
+        opt_cfg: Optional[OptimizerConfig] = None,
+        ft_spec: Optional[FinetuneSpec] = None,
+        mesh=None,
+        compute_dtype: str = "bfloat16",
+        length_bucket: int = 128,
+        rows_bucket: int = 8,
+        seqs_bucket: int = 8,
+        attn_impl: str = "auto",
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        self.length_bucket = length_bucket
+        self.rows_bucket = rows_bucket
+        self.seqs_bucket = seqs_bucket
+        self.attn_impl = attn_impl
+        if mesh is not None:
+            params = psh.shard_params(params, mesh, cfg)
+        else:
+            params = jax.tree.map(jnp.asarray, params)
+        self.params = params
+        self.opt_cfg = opt_cfg
+        self.tx = None
+        self.opt_state = None
+        self.lr_schedule = None
+        self.opt_step_count = 0
+        if opt_cfg is not None:
+            total = ft_spec.total_train_steps if ft_spec is not None else 1000
+            self.tx, self.lr_schedule = build_optimizer(opt_cfg, total)
+            self.opt_state = jax.jit(self.tx.init)(self.params)
+        self._grad_fns: Dict[int, Callable] = {}
+        self._fwd_fns: Dict[int, Callable] = {}
+        self._apply_fn = None
+
+    # -------------- internals --------------
+
+    def _mesh_ctx(self):
+        if self.mesh is not None:
+            return psh.activation_sharding(self.mesh)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def _cast(self, params):
+        cd = self.compute_dtype
+
+        def c(x):
+            return x.astype(cd) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        return jax.tree.map(c, params)
+
+    def _model_forward(self, params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        out, _ = transformer.forward(
+            self._cast(params),
+            self.cfg,
+            batch["tokens"],
+            batch["positions"],
+            segment_ids=batch["segment_ids"],
+            attn_impl=self.attn_impl,
+        )
+        return out.astype(jnp.float32)
+
+    def _get_grad_fn(self, loss_fn: LossFn) -> Callable:
+        key = id(loss_fn)
+        if key not in self._grad_fns:
+
+            def f(params, batch, denom):
+                def lf(p):
+                    out = self._model_forward(p, batch)
+                    loss_sum, stats = loss_fn(out, batch)
+                    return loss_sum / jnp.maximum(denom, 1.0), stats
+
+                (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                return loss, stats, grads
+
+            self._grad_fns[key] = jax.jit(f)
+        return self._grad_fns[key]
+
+    def _get_apply_fn(self) -> Callable:
+        if self._apply_fn is None:
+
+            def f(params, opt_state, grads):
+                updates, new_opt = self.tx.update(grads, opt_state, params)
+                gnorm = optax.global_norm(grads)
+                return optax.apply_updates(params, updates), new_opt, gnorm
+
+            # Donate old params/opt_state/grads: the update is in-place in HBM.
+            self._apply_fn = jax.jit(f, donate_argnums=(0, 1, 2))
+        return self._apply_fn
+
+    def _device_batch(self, mb: mbu.MicroBatch) -> Dict[str, jnp.ndarray]:
+        d: Dict[str, jnp.ndarray] = {}
+        for k, v in mb.grids.items():
+            d[k] = jnp.asarray(v)
+        for k, v in mb.scalars.items():
+            d[k] = jnp.asarray(v)
+        d["seq_rows"] = jnp.asarray(mb.seq_rows)
+        d["seq_first_cols"] = jnp.asarray(mb.seq_first_cols)
+        d["seq_last_cols"] = jnp.asarray(mb.seq_last_cols)
+        d["seq_mask"] = jnp.asarray(mb.seq_mask)
+        return d
+
+    # -------------- TrainableEngine API --------------
+
+    def train_batch(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_fn: LossFn,
+        loss_weight_fn: Callable[[mbu.MicroBatch], float],
+        token_normalize_scope: str = "global",
+        version_steps: int = 0,
+    ) -> Dict[str, float]:
+        """Grad-accumulate over micro-batches, single optimizer step.
+
+        ``loss_fn`` must return the SUM of per-token losses; it is divided by
+        the total ``loss_weight_fn`` mass of the whole batch ("global" scope,
+        reference megatron.py:410-494) or of each micro-batch ("mb")."""
+        assert self.tx is not None, "engine built without an optimizer"
+        mbs = mbu.split_into_microbatches(
+            input_, mb_spec, length_bucket=self.length_bucket,
+            rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+        )
+        weights = [float(loss_weight_fn(mb)) for mb in mbs]
+        total_w = sum(weights)
+        grad_fn = self._get_grad_fn(loss_fn)
+
+        grads_acc = None
+        loss_acc = 0.0
+        stats_acc: Dict[str, float] = {}
+        for mb, w in zip(mbs, weights):
+            denom = total_w if token_normalize_scope == "global" else w
+            batch = self._device_batch(mb)
+            with self._mesh_ctx():
+                loss, stats, grads = grad_fn(
+                    self.params, batch, jnp.asarray(denom, jnp.float32)
+                )
+            if token_normalize_scope != "global":
+                # mb scope: each micro-batch normalized by itself; average.
+                loss = loss / len(mbs)
+                grads = jax.tree.map(lambda g: g / len(mbs), grads)
+            grads_acc = (
+                grads
+                if grads_acc is None
+                else jax.tree.map(jnp.add, grads_acc, grads)
+            )
+            loss_acc += float(loss)
+            for k, v in stats.items():
+                stats_acc[k] = stats_acc.get(k, 0.0) + float(v)
+
+        self.params, self.opt_state, gnorm = self._get_apply_fn()(
+            self.params, self.opt_state, grads_acc
+        )
+        # optax evaluated the schedule at the PRE-increment count.
+        applied_lr = float(self.lr_schedule(self.opt_step_count))
+        self.opt_step_count += 1
+        out = dict(stats_acc)
+        out["loss"] = loss_acc
+        out["grad_norm"] = float(gnorm)
+        out["lr"] = applied_lr
+        out["n_tokens"] = float(sum(mb.n_tokens for mb in mbs))
+        out["loss_weight"] = total_w
+        return out
+
+    def forward(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        output_key: str = "logprobs",
+        post_hook: Optional[Callable] = None,
+    ) -> List[np.ndarray]:
+        """Micro-batched inference. ``post_hook(out, batch) -> [B, L, ...]``
+        maps raw model output (logits/values) to the per-token quantity —
+        applied on device so [B, L, V] logits never reach the host. Returns
+        per-sample packed arrays in input order."""
+        mbs = mbu.split_into_microbatches(
+            input_, mb_spec, length_bucket=self.length_bucket,
+            rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+        )
+        key = id(post_hook)
+        if key not in self._fwd_fns:
+
+            def f(params, batch):
+                out = self._model_forward(params, batch)
+                return post_hook(out, batch) if post_hook is not None else out
+
+            self._fwd_fns[key] = jax.jit(f)
+        fn = self._fwd_fns[key]
+        outs = []
+        for mb in mbs:
+            with self._mesh_ctx():
+                outs.append(np.asarray(fn(self.params, self._device_batch(mb))))
+        return mbu.scatter_back(mbs, outs, input_.bs)
+
+    def generate(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        gconfig: GenerationHyperparameters,
+        key: Optional[jax.Array] = None,
+        prompt_key: str = "packed_prompts",
+        eos_token_id: int = 1,
+        pad_token_id: int = 0,
+    ) -> Dict[str, np.ndarray]:
+        """In-process generation (the reference's non-SGLang path). Groups of
+        ``gconfig.n`` samples per prompt are produced by repeating prompts."""
+        assert input_.data is not None
+        if key is None:
+            key = jax.random.PRNGKey(self.opt_step_count)
+        offs = input_.offsets(prompt_key)
+        lens = input_.total_lens(prompt_key)
+        prompts = [
+            input_.data[prompt_key][o : o + l] for o, l in zip(offs, lens)
+        ]
+        if gconfig.n > 1:
+            prompts = [p for p in prompts for _ in range(gconfig.n)]
+        padded, plens = genmod.pad_prompts(prompts, pad_token_id)
+        with self._mesh_ctx():
+            out = genmod.generate_batch(
+                self.params if self.compute_dtype == jnp.float32
+                else self._cast(self.params),
+                self.cfg,
+                jnp.asarray(padded),
+                jnp.asarray(plens),
+                key,
+                gconfig,
+                max_new_tokens=gconfig.max_new_tokens,
+                eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id,
+                attn_impl=self.attn_impl,
+            )
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+# ---------------- backend registration ----------------
+
+
+@dataclasses.dataclass
+class JaxTrainBackend(ModelBackend):
+    """Builds a JaxTrainEngine for a Model whose ``module`` is a
+    (TransformerConfig, params) pair (what models/hf.py loaders return)."""
+
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    mesh: Any = None
+    compute_dtype: str = "bfloat16"
+    length_bucket: int = 128
+    rows_bucket: int = 8
+    seqs_bucket: int = 8
+    attn_impl: str = "auto"
+    train: bool = True
+
+    def initialize(self, model: Model, spec: FinetuneSpec) -> Model:
+        cfg, params = model.module
+        engine = JaxTrainEngine(
+            cfg,
+            params,
+            opt_cfg=self.optimizer if self.train else None,
+            ft_spec=spec,
+            mesh=self.mesh,
+            compute_dtype=self.compute_dtype,
+            length_bucket=self.length_bucket,
+            rows_bucket=self.rows_bucket,
+            seqs_bucket=self.seqs_bucket,
+            attn_impl=self.attn_impl,
+        )
+        model.module = engine
+        return model
+
+
+register_backend("jax_train", JaxTrainBackend)
+register_backend(
+    "jax_inference",
+    lambda **kw: JaxTrainBackend(train=False, **kw),
+)

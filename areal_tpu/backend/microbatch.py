@@ -1,0 +1,164 @@
+"""SequenceSample → fixed-shape device micro-batches.
+
+The jit boundary of every engine call. Replaces the reference's dynamic
+varlen micro-batching (``SequenceSample.split`` + flash-attn cu_seqlens) with
+bucketed [B, L] grids (models/packing.py) so XLA sees a small, stable set of
+shapes (SURVEY §7 hard-part 6: recompilation churn).
+
+Key-layout contract (deviation from the reference, by design): every
+per-token key of a sample has the SAME per-sample seqlens as the main token
+key (``packed_input_ids``) — logprobs/masks/etc are full-length with unused
+slots zeroed — so one PackLayout serves all keys. Scalar keys (one value per
+sample, e.g. rewards) ride along as [n_seqs] vectors plus (row, last_col)
+index arrays into the grid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.models import packing
+
+
+@dataclasses.dataclass
+class MicroBatch:
+    layout: packing.PackLayout
+    # [B, L] grids: always "tokens", "segment_ids", "positions"; plus one per
+    # extra token-aligned key.
+    grids: Dict[str, np.ndarray]
+    # [S] per-sequence vectors (scalar keys), padded to the seqs bucket.
+    scalars: Dict[str, np.ndarray]
+    # [S] grid coordinates per sequence (padded entries point at (0, 0)).
+    seq_rows: np.ndarray
+    seq_first_cols: np.ndarray
+    seq_last_cols: np.ndarray
+    # [S] 1.0 for real sequences, 0.0 for bucket padding.
+    seq_mask: np.ndarray
+    # indices into the parent sample for scatter-back (real sequences only)
+    sample_indices: List[int]
+
+    @property
+    def n_seqs(self) -> int:
+        return len(self.sample_indices)
+
+    @property
+    def n_tokens(self) -> int:
+        return int(sum(self.layout.seqlens))
+
+
+def split_into_microbatches(
+    sample: SequenceSample,
+    mb_spec: MicroBatchSpec,
+    token_key: str = "packed_input_ids",
+    length_bucket: int = 128,
+    rows_bucket: int = 8,
+    seqs_bucket: int = 8,
+    row_len: Optional[int] = None,
+) -> List[MicroBatch]:
+    """FFD-pack ``sample`` into ≥ n_mbs micro-batches capped at
+    max_tokens_per_mb, then grid-pack each micro-batch with bucketed shapes."""
+    sub_samples, groups = sample.split(mb_spec=mb_spec)
+    out = []
+    for sub, grp in zip(sub_samples, groups):
+        if sub.bs == 0:
+            continue
+        out.append(
+            make_microbatch(
+                sub, token_key=token_key, length_bucket=length_bucket,
+                rows_bucket=rows_bucket, seqs_bucket=seqs_bucket,
+                row_len=row_len, sample_indices=grp,
+            )
+        )
+    return out
+
+
+def make_microbatch(
+    sample: SequenceSample,
+    token_key: str = "packed_input_ids",
+    length_bucket: int = 128,
+    rows_bucket: int = 8,
+    seqs_bucket: int = 8,
+    row_len: Optional[int] = None,
+    sample_indices: Optional[Sequence[int]] = None,
+) -> MicroBatch:
+    assert sample.data is not None, "micro-batching needs materialized data"
+    seqlens = [int(x) for x in sample.total_lens(token_key)]
+    layout = packing.plan_packing(
+        seqlens, length_bucket=length_bucket, rows_multiple=rows_bucket,
+        row_len=row_len,
+    )
+    grid = packing.make_grid(layout)
+    grids: Dict[str, np.ndarray] = {
+        "tokens": packing.batch_from_packed(
+            sample.data[token_key].astype(np.int32), layout
+        ),
+        "segment_ids": grid["segment_ids"],
+        "positions": grid["positions"],
+    }
+    scalars: Dict[str, np.ndarray] = {}
+    total = sum(seqlens)
+    for k in sample.keys:
+        if k == token_key or sample.data.get(k) is None:
+            continue
+        v = sample.data[k]
+        if v.shape[0] == total and [sum(s) for s in sample.seqlens[k]] == seqlens:
+            grids[k] = packing.batch_from_packed(v, layout)
+        elif v.shape[0] == sample.bs:
+            scalars[k] = v
+        else:
+            raise ValueError(
+                f"key {k}: leading dim {v.shape[0]} is neither token-aligned "
+                f"({total}) nor per-sample ({sample.bs}); pad per-token keys "
+                "to full length (see module docstring)"
+            )
+    # Bucket the sequence count too: without this, every distinct n_seqs
+    # would recompile the jitted step (the [S]-shaped arrays below are jit
+    # inputs), re-introducing the churn the [B, L] bucketing removes.
+    n = len(seqlens)
+    S = packing.round_up(max(n, 1), seqs_bucket)
+    rows = np.zeros(S, np.int32)
+    firsts = np.zeros(S, np.int32)
+    lasts = np.zeros(S, np.int32)
+    seq_mask = np.zeros(S, np.float32)
+    rows[:n] = [p[0] for p in layout.placements]
+    firsts[:n] = [p[1] for p in layout.placements]
+    lasts[:n] = [p[1] + sl - 1 for p, sl in zip(layout.placements, layout.seqlens)]
+    seq_mask[:n] = 1.0
+    for k, v in scalars.items():
+        pad = np.zeros((S,) + v.shape[1:], v.dtype)
+        pad[:n] = v
+        scalars[k] = pad
+    return MicroBatch(
+        layout=layout,
+        grids=grids,
+        scalars=scalars,
+        seq_rows=rows,
+        seq_first_cols=firsts,
+        seq_last_cols=lasts,
+        seq_mask=seq_mask,
+        sample_indices=list(sample_indices) if sample_indices is not None else
+        list(range(sample.bs)),
+    )
+
+
+def scatter_back(
+    mbs: List[MicroBatch],
+    per_mb_grids: List[np.ndarray],  # [B, L, ...] device outputs per micro-batch
+    n_samples: int,
+) -> List[np.ndarray]:
+    """Undo the micro-batch split: per-sample packed arrays in the ORIGINAL
+    sample order (inverse of split_into_microbatches)."""
+    out: List[Optional[np.ndarray]] = [None] * n_samples
+    for mb, g in zip(mbs, per_mb_grids):
+        g = np.asarray(g)
+        for i, (placement, n) in enumerate(zip(mb.layout.placements, mb.layout.seqlens)):
+            row, col = placement
+            out[mb.sample_indices[i]] = g[row, col : col + n]
+    missing = [i for i, v in enumerate(out) if v is None]
+    if missing:
+        raise ValueError(f"samples {missing} appear in no micro-batch")
+    return out  # type: ignore

@@ -95,6 +95,11 @@ def packed_attention(
 ) -> jnp.ndarray:
     """Dispatch between the XLA reference and the Pallas TPU kernel."""
     explicit = impl == "pallas"
+    if explicit and sliding_window is not None:
+        raise NotImplementedError(
+            "pallas flash attention does not support sliding_window yet; "
+            "use impl='reference'"
+        )
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "reference"
     if impl == "pallas" and sliding_window is None:

@@ -92,7 +92,10 @@ class ParallelSpec:
     def __str__(self) -> str:
         return "".join(
             f"{l}{getattr(self, a)}"
-            for l, a in (("d", "dp"), ("f", "fsdp"), ("p", "pp"), ("s", "sp"), ("t", "tp"))
+            for l, a in (
+                ("d", "dp"), ("f", "fsdp"), ("p", "pp"), ("s", "sp"),
+                ("t", "tp"), ("e", "ep"),
+            )
             if getattr(self, a) != 1
         ) or "d1"
 

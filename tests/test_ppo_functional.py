@@ -1,0 +1,156 @@
+"""PPO math parity tests (mirrors tests/cpp_extensions/test_cugae.py and
+tests/data/test_dual_clip.py in the reference)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.algorithms import ppo_functional as F
+from areal_tpu.models import packing
+
+
+def _grid_from_packed(seqlens, *arrays, row_len=32):
+    layout = packing.plan_packing(seqlens, row_len=row_len)
+    grid = packing.make_grid(layout)
+    outs = [packing.batch_from_packed(a, layout) for a in arrays]
+    return layout, grid, outs
+
+
+@pytest.mark.parametrize("gamma,lam", [(1.0, 1.0), (0.99, 0.95)])
+@pytest.mark.parametrize("use_bootstrap", [False, True])
+def test_gae_grid_matches_packed_numpy(gamma, lam, use_bootstrap):
+    rng = np.random.RandomState(0)
+    seqlens = [5, 9, 3, 14, 1]
+    total = sum(seqlens)
+    rewards = rng.randn(total).astype(np.float32)
+    values = rng.randn(total).astype(np.float32)
+    bs = rng.rand(len(seqlens)).astype(np.float32) if use_bootstrap else None
+
+    adv_ref, ret_ref = F.gae_packed_np(
+        rewards, values, seqlens, bootstrap=bs, gamma=gamma, lam=lam
+    )
+
+    layout, grid, (r_g, v_g) = _grid_from_packed(seqlens, rewards, values)
+    boot_g = None
+    if use_bootstrap:
+        boot_g = np.zeros(layout.shape, np.float32)
+        for i, ((row, col), n) in enumerate(zip(layout.placements, layout.seqlens)):
+            boot_g[row, col + n - 1] = bs[i]
+    adv, ret = F.gae_grid(
+        jnp.asarray(r_g), jnp.asarray(v_g), jnp.asarray(grid["segment_ids"]),
+        bootstrap=None if boot_g is None else jnp.asarray(boot_g),
+        gamma=gamma, lam=lam,
+    )
+    np.testing.assert_allclose(
+        packing.packed_from_batch(np.asarray(adv), layout), adv_ref, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        packing.packed_from_batch(np.asarray(ret), layout), ret_ref, atol=1e-4
+    )
+
+
+def test_gae_independent_of_packing():
+    """Two sequences in one row must not leak advantage across the boundary."""
+    seqlens = [4, 4]
+    rewards = np.array([0, 0, 0, 1, 0, 0, 0, 1], np.float32)
+    values = np.zeros(8, np.float32)
+    layout, grid, (r_g, v_g) = _grid_from_packed(seqlens, rewards, values, row_len=8)
+    assert layout.n_rows == 1  # both sequences share the row
+    adv, _ = F.gae_grid(jnp.asarray(r_g), jnp.asarray(v_g),
+                        jnp.asarray(grid["segment_ids"]))
+    flat = packing.packed_from_batch(np.asarray(adv), layout)
+    np.testing.assert_allclose(flat[:4], flat[4:], atol=1e-6)
+
+
+def test_actor_loss_standard_vs_decoupled_reduction():
+    rng = np.random.RandomState(1)
+    shape = (2, 8)
+    lp = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.1)
+    old = jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.1)
+    adv = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    mask = jnp.asarray(rng.rand(*shape) > 0.3)
+    l_std, _ = F.actor_loss(lp, old, adv, mask, eps_clip=0.2)
+    # proximal == behaviour ⇒ decoupled loss equals standard PPO
+    l_dec, _ = F.actor_loss(lp, old, adv, mask, eps_clip=0.2, proximal_logprobs=old)
+    np.testing.assert_allclose(float(l_std), float(l_dec), rtol=1e-5)
+
+
+def test_actor_loss_dual_clip_bounds_negative_adv():
+    # Huge ratio and negative advantage: dual clip caps the loss.
+    lp = jnp.full((1, 1), 3.0)
+    old = jnp.zeros((1, 1))
+    adv = jnp.full((1, 1), -1.0)
+    mask = jnp.ones((1, 1), bool)
+    l_noclip, _ = F.actor_loss(lp, old, adv, mask, eps_clip=0.2)
+    l_dual, st = F.actor_loss(lp, old, adv, mask, eps_clip=0.2, c_clip=5.0)
+    assert float(l_dual) == pytest.approx(5.0)  # -adv * c_clip
+    assert float(l_noclip) > float(l_dual)
+    assert float(st["dual_clip_ratio"]) == 1.0
+
+
+def test_actor_loss_behav_cap_drops_tokens():
+    lp = jnp.zeros((1, 2))
+    behav = jnp.asarray([[0.0, -5.0]])  # second token: behav weight e^5 ≈ 148
+    prox = jnp.zeros((1, 2))
+    adv = jnp.ones((1, 2))
+    mask = jnp.ones((1, 2), bool)
+    l_cap, _ = F.actor_loss(
+        lp, behav, adv, mask, proximal_logprobs=prox, behav_imp_weight_cap=10.0
+    )
+    l_first_only, _ = F.actor_loss(
+        lp[:, :1], behav[:, :1], adv[:, :1], mask[:, :1], proximal_logprobs=prox[:, :1]
+    )
+    # Capped token contributes 0; denominator still counts both tokens.
+    np.testing.assert_allclose(float(l_cap), float(l_first_only) / 2, rtol=1e-5)
+
+
+def test_critic_loss_clip():
+    v = jnp.full((1, 1), 2.0)
+    old = jnp.zeros((1, 1))
+    ret = jnp.full((1, 1), 2.0)
+    mask = jnp.ones((1, 1), bool)
+    # clipped prediction (0.2) is far from the target ⇒ max picks clipped loss
+    loss, st = F.critic_loss(v, old, ret, mask, value_eps_clip=0.2, loss_fn="mse")
+    assert float(loss) == pytest.approx(0.5 * 1.8**2)
+    assert float(st["value_clip_ratio"]) == 1.0
+
+
+def test_masked_normalization():
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 8).astype(np.float32))
+    mask = jnp.asarray(np.random.RandomState(3).rand(4, 8) > 0.4)
+    y = F.masked_normalization(x, mask)
+    yn = np.asarray(y)[np.asarray(mask)]
+    assert abs(yn.mean()) < 1e-3 and abs(yn.std() - 1.0) < 5e-2
+    assert (np.asarray(y)[~np.asarray(mask)] == 0).all()
+
+
+def test_kl_controllers():
+    c = F.FixedKLController(0.1)
+    c.update(10.0, 1)
+    assert c.value == 0.1
+    a = F.AdaptiveKLController(init_kl_coef=0.1, target=1.0, horizon=100)
+    a.update(2.0, 10)  # kl above target → coef grows
+    assert a.value > 0.1
+    a2 = F.AdaptiveKLController(init_kl_coef=0.1, target=1.0, horizon=100)
+    a2.update(0.1, 10)  # below target → shrinks
+    assert a2.value < 0.1
+
+
+def test_shape_rewards_places_score_at_last_token():
+    seqlens = [3, 2]
+    layout, grid, _ = _grid_from_packed(
+        seqlens, np.zeros(5, np.float32), row_len=8
+    )
+    mask = jnp.asarray(grid["segment_ids"] > 0)
+    kl = jnp.ones(layout.shape) * 0.5
+    rows = jnp.asarray([p[0] for p in layout.placements])
+    lasts = jnp.asarray(
+        [p[1] + n - 1 for p, n in zip(layout.placements, layout.seqlens)]
+    )
+    r = F.shape_rewards(
+        jnp.asarray([1.0, -1.0]), kl, mask, lasts, rows, kl_coef=0.1,
+    )
+    r = np.asarray(r)
+    # last tokens: score − kl penalty; others: just −kl penalty
+    np.testing.assert_allclose(r[0, 2], 1.0 - 0.05, atol=1e-6)
+    np.testing.assert_allclose(r[0, 0], -0.05, atol=1e-6)
