@@ -1,0 +1,581 @@
+"""PPO actor / critic interfaces — the algorithm layer.
+
+Parity target: ``realhf/impl/model/interface/ppo_interface.py`` —
+``PPOActorInterface`` (:210; generate :301, inference :474 recomputing
+proximal logprobs, train_step :527 with GAE + reward shaping + advantage
+normalization + minibatch loop) and ``PPOCriticInterface`` (:984), plus the
+value-normalization running moments (``realhf/impl/model/modules/rms.py``).
+
+Data contract (all per-token keys full-length aligned to
+``packed_input_ids``; see backend/microbatch.py):
+ - ``packed_input_ids`` int32, ``prompt_mask`` (1 on prompt tokens)
+ - ``packed_logprobs`` f32 — behaviour-policy logprob of token t at slot t
+   (0 on prompt slots and each doc's first token)
+ - ``prox_logprobs`` f32 — recomputed under the trainer's current policy
+   (decoupled PPO; produced by actor ``inference``)
+ - ``packed_ref_logprobs`` f32 — reference-policy logprobs (KL penalty)
+ - ``values`` f32 — critic values (denormalized; produced by critic
+   ``inference``), absent/zero when ``disable_value`` (GRPO)
+ - ``rewards`` f32 [1/sample] — task score; ``seq_no_eos_mask`` f32
+   [1/sample] — 1.0 when generation was truncated (no EOS)
+ - ``task_ids`` int32 [1/sample]
+
+Deviation from the reference, by design: generated groups are FLATTENED into
+independent samples (ids "qid@k", metadata ``group``) rather than grouped
+seqlens inside one sample — packing/attention masks stay per-document and
+GRPO group statistics use the metadata instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.algorithms import ppo_functional as F
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import (
+    GenerationHyperparameters,
+    Model,
+    ModelInterface,
+    register_interface,
+)
+from areal_tpu.backend import microbatch as mbu
+from areal_tpu.base import logging
+from areal_tpu.models import packing
+
+logger = logging.getLogger("algorithms.ppo")
+
+
+@dataclasses.dataclass
+class PPOHyperparameters:
+    """Reference cli_args.py:597 (PPOHyperparameters)."""
+
+    gen: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    c_clip: Optional[float] = None
+    value_eps_clip: float = 0.2
+    early_stop_imp_ratio: float = 5.0
+    reward_output_scaling: float = 1.0
+    reward_output_bias: float = 0.0
+    max_reward_clip: float = 20.0
+    mask_no_eos_with_zero: bool = False
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: bool = True
+    kl_ctl: float = 0.1
+    use_adaptive_kl_ctl: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+    disable_value: bool = False  # GRPO: no critic
+    value_norm: bool = True
+    value_norm_beta: float = 0.99995
+    value_norm_eps: float = 1e-5
+    group_size: int = 1
+    group_adv_norm: bool = False
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: Optional[float] = None
+    recompute_logprob: bool = False
+
+
+class RunningMoments:
+    """EMA mean/std for value normalization (reference rms.py)."""
+
+    def __init__(self, beta: float = 0.99995, eps: float = 1e-5):
+        self.beta = beta
+        self.eps = eps
+        self.mean = 0.0
+        self.var = 1.0
+        self._initialized = False
+
+    def update(self, x: np.ndarray, mask: np.ndarray) -> None:
+        m = mask.astype(bool)
+        if m.sum() == 0:
+            return
+        bm, bv = float(x[m].mean()), float(x[m].var())
+        if not self._initialized:
+            self.mean, self.var = bm, max(bv, self.eps)
+            self._initialized = True
+        else:
+            self.mean = self.beta * self.mean + (1 - self.beta) * bm
+            self.var = self.beta * self.var + (1 - self.beta) * bv
+
+    def normalize(self, x):
+        return (x - self.mean) / np.sqrt(self.var + self.eps)
+
+    def denormalize(self, x):
+        return x * np.sqrt(self.var + self.eps) + self.mean
+
+    def state_dict(self):
+        return {
+            "mean": self.mean, "var": self.var, "initialized": self._initialized
+        }
+
+    def load_state_dict(self, d):
+        self.mean, self.var = d["mean"], d["var"]
+        self._initialized = d["initialized"]
+
+
+# ---------------- shared prep ----------------
+
+def _action_mask(grids: Dict[str, np.ndarray]) -> np.ndarray:
+    """Host-side view of the shared loss mask (ppo_functional)."""
+    return np.asarray(
+        F.action_token_mask(grids["segment_ids"], grids["prompt_mask"])
+    )
+
+
+def compute_advantages_and_returns(
+    sample: SequenceSample, hp: PPOHyperparameters, kl_coef: float
+) -> Dict[str, np.ndarray]:
+    """Full-batch grid pass: KL-shaped token rewards → GAE. Returns packed
+    1-D arrays keyed advantages/returns/kl_rewards plus scalar stats.
+
+    Mirrors reference train_step pre-processing (ppo_interface.py:560-690):
+    sparse task reward on the last token, −kl_coef·KL(π_behav‖π_ref)
+    everywhere, GAE over values (zeros under GRPO)."""
+    mb = mbu.make_microbatch(sample, length_bucket=64, rows_bucket=1, seqs_bucket=1)
+    g = mb.grids
+    amask = _action_mask(g)
+    behav = g["packed_logprobs"]
+    ref = g.get("packed_ref_logprobs", np.zeros_like(behav))
+    kl = (behav - ref) * amask  # k1 estimator, same as reference
+    values = g.get("values", np.zeros_like(behav)) * (g["segment_ids"] > 0)
+
+    score = np.asarray(sample.data["rewards"], np.float32).reshape(-1)
+    if "seq_no_eos_mask" in sample.keys and hp.mask_no_eos_with_zero:
+        no_eos = np.asarray(sample.data["seq_no_eos_mask"]).reshape(-1) > 0
+        score = np.where(no_eos, 0.0, score)
+    n = mb.n_seqs
+    rewards = np.asarray(
+        F.shape_rewards(
+            jnp.asarray(np.concatenate([score, np.zeros(len(mb.seq_rows) - n)])
+                        .astype(np.float32)),
+            jnp.asarray(kl),
+            jnp.asarray(amask),
+            jnp.asarray(mb.seq_last_cols),
+            jnp.asarray(mb.seq_rows),
+            kl_coef=kl_coef,
+            reward_scaling=hp.reward_output_scaling,
+            reward_bias=hp.reward_output_bias,
+            clip=hp.max_reward_clip,
+        )
+    )
+    # GAE over action tokens only: restrict the segment grid to them so
+    # prompt positions neither receive advantage nor relay the recursion.
+    act_seg = np.where(amask, g["segment_ids"], 0)
+    adv, ret = F.gae_grid(
+        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(act_seg),
+        gamma=hp.discount, lam=hp.gae_lambda,
+    )
+    adv, ret = np.asarray(adv), np.asarray(ret)
+    out = {}
+    for key, grid in (("advantages", adv), ("returns", ret), ("kl_rewards", rewards)):
+        out[key] = np.concatenate(
+            mbu.scatter_back([mb], [grid], sample.bs)
+        ).astype(np.float32)
+    out["_mean_kl"] = float(kl.sum() / max(amask.sum(), 1))
+    return out
+
+
+def _group_keys(sample: SequenceSample) -> List[str]:
+    if "group" in sample.metadata:
+        return [str(x) for x in sample.metadata["group"]]
+    return [str(i).rsplit("@", 1)[0] for i in sample.ids]
+
+
+def normalize_advantages(
+    sample: SequenceSample, hp: PPOHyperparameters
+) -> None:
+    """In-place advantage whitening: global, or per prompt-group (GRPO)."""
+    adv = sample.data["advantages"]
+    amask_packed = (
+        (1 - np.asarray(sample.data["prompt_mask"])) > 0
+    )  # includes doc-first token; its adv is 0 anyway
+    if hp.group_adv_norm:
+        groups = _group_keys(sample)
+        offs = sample.offsets("advantages")
+        lens = [int(x) for x in sample.total_lens("advantages")]
+        for gkey in set(groups):
+            idx = [i for i, g in enumerate(groups) if g == gkey]
+            sel = np.concatenate(
+                [np.arange(offs[i], offs[i] + lens[i]) for i in idx]
+            )
+            m = amask_packed[sel]
+            vals = adv[sel]
+            mu = vals[m].mean() if m.any() else 0.0
+            sd = vals[m].std() + 1e-5
+            adv[sel] = np.where(m, (vals - mu) / sd, 0.0)
+    else:
+        m = amask_packed
+        mu = adv[m].mean() if m.any() else 0.0
+        sd = adv[m].std() + 1e-5
+        sample.data["advantages"] = np.where(m, (adv - mu) / sd, 0.0).astype(
+            np.float32
+        )
+
+
+# ---------------- actor ----------------
+
+class PPOActorInterface(ModelInterface):
+    def __init__(self, hp: Optional[PPOHyperparameters] = None, **kw):
+        self.hp = hp or PPOHyperparameters(**kw)
+        if self.hp.use_adaptive_kl_ctl:
+            self.kl_ctl = F.AdaptiveKLController(
+                self.hp.kl_ctl, self.hp.adaptive_kl_target, self.hp.adaptive_kl_horizon
+            )
+        else:
+            self.kl_ctl = F.FixedKLController(self.hp.kl_ctl)
+        hp_ = self.hp
+
+        def actor_loss_fn(logits, batch):
+            lp = F.token_logprobs_from_logits(
+                logits, batch["tokens"], batch["segment_ids"]
+            )
+            amask = F.action_token_mask(
+                batch["segment_ids"], batch["prompt_mask"]
+            )
+            prox = batch.get("prox_logprobs") if hp_.use_decoupled_loss else None
+            loss, st = F.actor_loss(
+                lp,
+                batch["packed_logprobs"],
+                batch["advantages"],
+                amask,
+                eps_clip=hp_.eps_clip,
+                c_clip=hp_.c_clip,
+                proximal_logprobs=prox,
+                behav_imp_weight_cap=hp_.behav_imp_weight_cap,
+                loss_scale=jnp.asarray(1.0),  # sum; engine divides by weight
+            )
+            n = jnp.sum(amask)
+            stats = {f"{k}_sum": v * 1.0 for k, v in st.items()}
+            stats["n_action_tokens"] = n
+            # approx KL(new ‖ behav) for the adaptive controller
+            stats["kl_sum"] = jnp.sum((batch["packed_logprobs"] - lp) * amask)
+            return loss, stats
+
+        self._loss_fn = actor_loss_fn
+
+    # ---- MFC methods ----
+
+    def generate(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Prompt batch → flattened trajectory batch (group_size per prompt)."""
+        hp = self.hp
+        engine = model.module
+        eos = getattr(model.tokenizer, "eos_token_id", 1) or 1
+        pad = getattr(model.tokenizer, "pad_token_id", 0) or 0
+        gconfig = dataclasses.replace(hp.gen, n=hp.group_size)
+        out = engine.generate(
+            data, mb_spec, gconfig,
+            key=jax.random.PRNGKey(model.version.global_step),
+            eos_token_id=eos, pad_token_id=pad,
+        )
+        return trajectories_from_gen_output(
+            data, out, group_size=hp.group_size,
+            version=model.version.global_step, eos_token_id=eos,
+        )
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Recompute logprobs under the current policy → prox_logprobs."""
+        engine = model.module
+        per_sample = engine.forward(data, mb_spec, post_hook=_logprob_hook)
+        return SequenceSample(
+            ids=list(data.ids),
+            keys={"prox_logprobs"},
+            seqlens={"prox_logprobs": [list(s) for s in
+                                       data.seqlens["packed_input_ids"]]},
+            data={"prox_logprobs": np.concatenate(per_sample).astype(np.float32)},
+        )
+
+    def train_step(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        hp = self.hp
+        engine = model.module
+        extra = compute_advantages_and_returns(data, hp, self.kl_ctl.value)
+        mean_kl = extra.pop("_mean_kl")
+        data = attach_keys(data, extra)
+        if hp.adv_norm or hp.group_adv_norm:
+            normalize_advantages(data, hp)
+
+        # PPO minibatch loop (reference ppo_interface.py:698-760): split the
+        # batch into ppo_n_minibatches, one optimizer step each.
+        minibatches, _ = data.split(k=min(hp.ppo_n_minibatches, data.bs))
+        agg: Dict[str, float] = {}
+        n_steps = 0
+        for mb_sample in minibatches:
+            if mb_sample.bs == 0:
+                continue
+            stats = engine.train_batch(
+                mb_sample, mb_spec, self._loss_fn,
+                _action_token_weight,
+                version_steps=model.version.global_step,
+            )
+            n_steps += 1
+            n = max(stats.get("n_action_tokens", 1.0), 1.0)
+            imp = stats.get("importance_weight_sum", 0.0) / n
+            for k, v in stats.items():
+                agg[k] = agg.get(k, 0.0) + float(v)
+            if hp.early_stop_imp_ratio and imp > hp.early_stop_imp_ratio:
+                logger.warning(
+                    f"early-stopping PPO minibatches: importance ratio "
+                    f"{imp:.2f} > {hp.early_stop_imp_ratio}"
+                )
+                break
+        self.kl_ctl.update(mean_kl, n_steps=1)
+        model.inc_version()
+        n = max(agg.get("n_action_tokens", 1.0), 1.0)
+        return {
+            "actor_loss": agg.get("loss", 0.0),
+            "importance_weight": agg.get("importance_weight_sum", 0.0) / n,
+            "clip_ratio": agg.get("clip_ratio_sum", 0.0) / n,
+            "dual_clip_ratio": agg.get("dual_clip_ratio_sum", 0.0) / n,
+            "mean_kl": mean_kl,
+            "kl_coef": self.kl_ctl.value,
+            "grad_norm": agg.get("grad_norm", 0.0) / max(n_steps, 1),
+            "lr": agg.get("lr", 0.0) / max(n_steps, 1),
+            "n_action_tokens": agg.get("n_action_tokens", 0.0),
+            "task_reward": float(np.mean(np.asarray(data.data["rewards"]))),
+        }
+
+    def save(self, model: Model, save_dir: str) -> None:
+        from areal_tpu.models import hf as hfmod
+
+        engine = model.module
+        hfmod.save_hf_checkpoint(
+            jax.device_get(engine.params), engine.cfg, save_dir,
+            meta={"version": model.version.global_step},
+        )
+
+    def state_dict(self):
+        return {"kl_ctl": getattr(self.kl_ctl, "_value", self.kl_ctl.value)}
+
+    def load_state_dict(self, d):
+        if hasattr(self.kl_ctl, "_value"):
+            self.kl_ctl._value = d["kl_ctl"]
+
+
+def _logprob_hook(logits, batch):
+    return F.token_logprobs_from_logits(
+        logits, batch["tokens"], batch["segment_ids"]
+    )
+
+
+def _values_hook(values, batch):
+    # critic forward output is [B, L] already
+    return values * (batch["segment_ids"] > 0)
+
+
+def _action_token_weight(mb: mbu.MicroBatch) -> float:
+    return float(_action_mask(mb.grids).sum())
+
+
+def attach_keys(data: SequenceSample, extra: Dict[str, np.ndarray]) -> SequenceSample:
+    """New sample with full-length per-token keys added (non-mutating)."""
+    sls = data.seqlens["packed_input_ids"]
+    return SequenceSample(
+        ids=list(data.ids),
+        keys=set(data.keys) | set(extra.keys()),
+        seqlens={**data.seqlens, **{k: [list(s) for s in sls] for k in extra}},
+        data={**data.data, **extra},
+        metadata=data.metadata,
+    )
+
+
+# ---------------- critic ----------------
+
+class PPOCriticInterface(ModelInterface):
+    def __init__(self, hp: Optional[PPOHyperparameters] = None, **kw):
+        self.hp = hp or PPOHyperparameters(**kw)
+        self.rms = RunningMoments(self.hp.value_norm_beta, self.hp.value_norm_eps)
+        hp_ = self.hp
+
+        def critic_loss_fn(values, batch):
+            amask = F.action_token_mask(
+                batch["segment_ids"], batch["prompt_mask"]
+            )
+            loss, st = F.critic_loss(
+                values,
+                batch["values"],
+                batch["_norm_returns"],
+                amask,
+                value_eps_clip=hp_.value_eps_clip,
+                loss_scale=jnp.asarray(1.0),
+            )
+            return loss, {
+                "value_clip_ratio_sum": st["value_clip_ratio"],
+                "n_action_tokens": jnp.sum(amask),
+            }
+
+        self._loss_fn = critic_loss_fn
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Critic forward → denormalized per-token values."""
+        engine = model.module
+        per_sample = engine.forward(data, mb_spec, post_hook=_values_hook)
+        vals = np.concatenate(per_sample).astype(np.float32)
+        if self.hp.value_norm:
+            vals = self.rms.denormalize(vals).astype(np.float32)
+        return SequenceSample(
+            ids=list(data.ids),
+            keys={"values"},
+            seqlens={"values": [list(s) for s in data.seqlens["packed_input_ids"]]},
+            data={"values": vals},
+        )
+
+    def train_step(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        hp = self.hp
+        engine = model.module
+        extra = compute_advantages_and_returns(data, hp, 0.0)
+        extra.pop("_mean_kl")
+        returns = extra["returns"]
+        pm = np.asarray(data.data["prompt_mask"])
+        amask = (1 - pm) > 0
+        if hp.value_norm:
+            self.rms.update(returns, amask)
+            extra["_norm_returns"] = self.rms.normalize(returns).astype(np.float32)
+        else:
+            extra["_norm_returns"] = returns
+        # The critic trains in normalized space; its stored "values" input
+        # key must be normalized the same way for the clip baseline.
+        if hp.value_norm and "values" in data.keys:
+            data = attach_keys(
+                data,
+                {"values": self.rms.normalize(
+                    np.asarray(data.data["values"])).astype(np.float32)},
+            )
+        data = attach_keys(data, extra)
+        minibatches, _ = data.split(k=min(hp.ppo_n_minibatches, data.bs))
+        agg: Dict[str, float] = {}
+        n_steps = 0
+        for mb_sample in minibatches:
+            if mb_sample.bs == 0:
+                continue
+            stats = engine.train_batch(
+                mb_sample, mb_spec, self._loss_fn, _action_token_weight,
+                version_steps=model.version.global_step,
+            )
+            n_steps += 1
+            for k, v in stats.items():
+                agg[k] = agg.get(k, 0.0) + float(v)
+        model.inc_version()
+        n = max(agg.get("n_action_tokens", 1.0), 1.0)
+        return {
+            "critic_loss": agg.get("loss", 0.0),
+            "value_clip_ratio": agg.get("value_clip_ratio_sum", 0.0) / n,
+            "grad_norm": agg.get("grad_norm", 0.0) / max(n_steps, 1),
+            "value_mean": float(self.rms.mean),
+            "value_var": float(self.rms.var),
+        }
+
+    def state_dict(self):
+        return {"rms": self.rms.state_dict()}
+
+    def load_state_dict(self, d):
+        self.rms.load_state_dict(d["rms"])
+
+
+register_interface("ppo_critic", PPOCriticInterface)
+
+
+def trajectories_from_gen_output(
+    prompts: SequenceSample,
+    gen_out: Dict[str, np.ndarray],
+    group_size: int,
+    version: int,
+    eos_token_id: int = 1,
+) -> SequenceSample:
+    """Assemble flattened trajectory samples from engine.generate output."""
+    offs = prompts.offsets("packed_prompts")
+    plens = prompts.total_lens("packed_prompts")
+    ids, seqlens = [], []
+    toks, pmask, lps = [], [], []
+    rows = []
+    n_eos = []
+    for i in range(prompts.bs):
+        prompt = prompts.data["packed_prompts"][offs[i] : offs[i] + plens[i]]
+        for j in range(group_size):
+            r = i * group_size + j
+            gl = int(gen_out["output_lens"][r])
+            gl = max(gl, 1)
+            g_toks = gen_out["output_ids"][r][:gl]
+            g_lps = gen_out["output_logprobs"][r][:gl]
+            ids.append(f"{prompts.ids[i]}@{j}")
+            seqlens.append(len(prompt) + gl)
+            toks.append(np.concatenate([prompt, g_toks]))
+            pmask.append(
+                np.concatenate([np.ones(len(prompt), np.int32),
+                                np.zeros(gl, np.int32)])
+            )
+            lps.append(
+                np.concatenate([np.zeros(len(prompt), np.float32), g_lps])
+            )
+            rows.append(r)
+            # Truncated iff EOS never appeared among the emitted tokens
+            # (gen_mask.all() alone misses EOS landing on the final slot).
+            n_eos.append(float(eos_token_id not in g_toks))
+    md_task = prompts.metadata.get("task", ["math"] * prompts.bs)
+    return SequenceSample.from_default(
+        ids=ids,
+        data={
+            "packed_input_ids": np.concatenate(toks).astype(np.int32),
+            "prompt_mask": np.concatenate(pmask),
+            "packed_logprobs": np.concatenate(lps).astype(np.float32),
+            "seq_no_eos_mask": np.asarray(n_eos, np.float32),
+            "task_ids": np.repeat(
+                np.asarray(
+                    prompts.data.get(
+                        "task_ids", np.zeros(prompts.bs, np.int32)
+                    )
+                ).reshape(-1),
+                group_size,
+            ),
+            "version_start": np.full(len(ids), version, np.int32),
+            "version_end": np.full(len(ids), version, np.int32),
+        },
+        seqlens=seqlens,
+        metadata={
+            "group": [str(prompts.ids[i]) for i in range(prompts.bs)
+                      for _ in range(group_size)],
+            "task": [md_task[i] for i in range(prompts.bs)
+                     for _ in range(group_size)],
+        },
+    )
+
+
+class LogprobInterface(ModelInterface):
+    """Frozen-model logprob recompute (the reference's ref_inf MFC: actor
+    ``inference`` run on the reference policy with an output-key remap)."""
+
+    def __init__(self, output_key: str = "packed_ref_logprobs"):
+        self.output_key = output_key
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        per_sample = model.module.forward(data, mb_spec, post_hook=_logprob_hook)
+        return SequenceSample(
+            ids=list(data.ids),
+            keys={self.output_key},
+            seqlens={self.output_key: [list(s) for s in
+                                       data.seqlens["packed_input_ids"]]},
+            data={self.output_key: np.concatenate(per_sample).astype(np.float32)},
+        )
+
+
+register_interface("ppo_actor", PPOActorInterface)
+register_interface("ref_logprob", LogprobInterface)

@@ -39,6 +39,37 @@ def gather_logprobs(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
 
 
+def token_logprobs_from_logits(
+    logits: jnp.ndarray,  # [B, L, V]
+    tokens: jnp.ndarray,  # [B, L]
+    segment_ids: jnp.ndarray,  # [B, L], 0 = pad
+) -> jnp.ndarray:
+    """[B, L] where position t holds log p(token_t | prefix), i.e. the
+    model's score of token t from the logits at t−1 within the same doc;
+    0 at each doc's first token and on padding. This is the grid version of
+    the reference's gather_packed_shifted_log_probs (utils/functional.py)."""
+    # s[t] = logprob of token_{t+1} under logits[t]; then shift right.
+    labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+    s = gather_logprobs(logits, labels)
+    tok_lp = jnp.concatenate([jnp.zeros_like(s[:, :1]), s[:, :-1]], axis=1)
+    prev_seg = jnp.concatenate(
+        [jnp.zeros_like(segment_ids[:, :1]), segment_ids[:, :-1]], axis=1
+    )
+    valid = (segment_ids > 0) & (prev_seg == segment_ids)
+    return tok_lp * valid
+
+
+def action_token_mask(segment_ids, prompt_mask):
+    """Generated-token positions with a valid (non-doc-first) logprob — THE
+    loss mask shared by actor/critic losses and host-side token counting.
+    Accepts numpy or jax arrays; returns a bool array of the same kind."""
+    xp = jnp if isinstance(segment_ids, jnp.ndarray) else np
+    prev_seg = xp.concatenate(
+        [xp.zeros_like(segment_ids[:, :1]), segment_ids[:, :-1]], axis=1
+    )
+    return (segment_ids > 0) & (prev_seg == segment_ids) & (prompt_mask == 0)
+
+
 def masked_normalization(
     x: jnp.ndarray,
     mask: jnp.ndarray,

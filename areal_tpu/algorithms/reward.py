@@ -1,0 +1,89 @@
+"""Rule-based multi-task reward interface (math + code).
+
+Parity target: ``realhf/impl/model/interface/math_rw_interface.py:181``
+(``MultiTaskRewardInterface``, registered "rw-math-code"): decode the
+generated suffix of each trajectory, dispatch per ``task_ids`` to the math
+or code verifier (remote functioncall service or local fallback), and emit a
+scalar reward per sequence. No learned reward model is involved — the
+"reward model" role is tokenizer-only, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Hashable, Optional
+
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import Model, ModelInterface, register_interface
+from areal_tpu.base import logging
+from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl
+from areal_tpu.rewards.client import batch_reward
+
+logger = logging.getLogger("algorithms.reward")
+
+
+@dataclasses.dataclass
+class MultiTaskRewardInterface(ModelInterface):
+    """``id2info`` maps query_id → dataset record ({"task", "solutions",
+    "input_output", ...}); built from ``dataset_path`` when given. Sample ids
+    are "qid@k" (flattened groups) or bare qids."""
+
+    dataset_path: Optional[str] = None
+    id2info: Optional[Dict[Hashable, Dict[str, Any]]] = None
+    group_size: int = 1
+    check_verifier_status: bool = False
+
+    def __post_init__(self):
+        if self.id2info is None and self.dataset_path:
+            self.id2info = {
+                str(d["query_id"]): d for d in load_jsonl(self.dataset_path)
+            }
+        self.id2info = self.id2info or {}
+
+    def _lookup(self, sample_id: Hashable) -> Dict[str, Any]:
+        qid = str(sample_id).rsplit("@", 1)[0]
+        return self.id2info.get(qid, {})
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        tok = model.tokenizer
+        offs = data.offsets("packed_input_ids")
+        lens = data.total_lens("packed_input_ids")
+        pm = np.asarray(data.data["prompt_mask"])
+        task_ids = np.asarray(
+            data.data.get("task_ids", np.zeros(data.bs, np.int32))
+        ).reshape(-1)
+        tasks = []
+        for i in range(data.bs):
+            span = slice(int(offs[i]), int(offs[i] + lens[i]))
+            gen_tokens = data.data["packed_input_ids"][span][pm[span] == 0]
+            text = tok.decode(gen_tokens) if tok is not None else ""
+            info = self._lookup(data.ids[i])
+            kind = info.get("task") or RL_TASKS[int(task_ids[i])]
+            task: Dict[str, Any] = {"task": kind, "generated": text}
+            if kind == "code":
+                task["input_output"] = info.get("input_output", "{}")
+            else:
+                task["solutions"] = info.get("solutions", [])
+            tasks.append(task)
+        scores = np.asarray(batch_reward(tasks), np.float32)
+        if self.check_verifier_status and float(np.abs(scores).sum()) == 0:
+            logger.warning(
+                "all rewards are zero — check the verifier / dataset wiring"
+            )
+        logger.info(
+            f"reward batch: n={data.bs} mean={scores.mean():.3f} "
+            f"solve_rate={(scores > 0).mean():.3f}"
+        )
+        return SequenceSample.from_default(
+            ids=list(data.ids),
+            data={"rewards": scores},
+            seqlens=[1] * data.bs,
+        )
+
+
+register_interface("rw_math_code", MultiTaskRewardInterface)
+register_interface("rw-math-code", MultiTaskRewardInterface)

@@ -1,0 +1,98 @@
+"""SFT interface — packed cross-entropy over answer tokens.
+
+Parity target: ``realhf/impl/model/interface/sft_interface.py:86`` (packed CE
+loss ``:24``). Data contract: ``packed_input_ids`` + ``prompt_mask`` (1 on
+prompt tokens, excluded from the loss).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import Model, ModelInterface, register_interface
+from areal_tpu.algorithms import ppo_functional as F
+
+
+def sft_loss(logits: jnp.ndarray, batch: Dict[str, jnp.ndarray]):
+    """Sum of -logp over answer tokens. Token t is scored by logits at t-1
+    (same doc), so the first token of each doc never contributes."""
+    lp = F.token_logprobs_from_logits(logits, batch["tokens"], batch["segment_ids"])
+    w = batch["_sft_loss_mask"]
+    loss = -jnp.sum(lp * w)
+    return loss, {"n_tokens": jnp.sum(w), "nll_sum": loss}
+
+
+def _loss_weight(mb) -> float:
+    return float(mb.grids["_sft_loss_mask"].sum())
+
+
+@dataclasses.dataclass
+class SFTInterface(ModelInterface):
+    token_normalize_scope: str = "global"
+
+    def train_step(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        engine = model.module
+        data = _attach_loss_mask(data)
+        stats = engine.train_batch(
+            data, mb_spec, sft_loss, _loss_weight,
+            token_normalize_scope=self.token_normalize_scope,
+            version_steps=model.version.global_step,
+        )
+        model.inc_version()
+        n = max(stats.pop("n_tokens", 1.0), 1.0)
+        stats["ppl"] = float(jnp.exp(jnp.minimum(stats["nll_sum"] / n, 20.0)))
+        return stats
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Eval: per-sample NLL (used by eval loops)."""
+        engine = model.module
+        data = _attach_loss_mask(data)
+
+        def hook(logits, batch):
+            lp = F.token_logprobs_from_logits(
+                logits, batch["tokens"], batch["segment_ids"]
+            )
+            return -lp * batch["_sft_loss_mask"]
+
+        per_sample = engine.forward(data, mb_spec, post_hook=_stable(hook))
+        import numpy as np
+
+        nll = np.asarray([p.sum() for p in per_sample], np.float32)
+        return SequenceSample.from_default(
+            ids=data.ids, data={"eval_nll": nll}, seqlens=[1] * data.bs
+        )
+
+
+_HOOKS = {}
+
+
+def _stable(fn):
+    """Keep one hook instance per name so engine jit caches stay warm."""
+    return _HOOKS.setdefault(fn.__name__, fn)
+
+
+def _attach_loss_mask(data: SequenceSample) -> SequenceSample:
+    """Answer-token mask as a full-length key (grids ride the layout)."""
+    import numpy as np
+
+    pm = data.data["prompt_mask"]
+    lm = (1 - np.asarray(pm)).astype(np.float32)
+    d = SequenceSample(
+        ids=list(data.ids),
+        keys=set(data.keys) | {"_sft_loss_mask"},
+        seqlens={**data.seqlens, "_sft_loss_mask": data.seqlens["packed_input_ids"]},
+        data={**data.data, "_sft_loss_mask": lm},
+        metadata=data.metadata,
+    )
+    return d
+
+
+register_interface("sft", SFTInterface)

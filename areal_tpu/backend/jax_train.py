@@ -268,11 +268,13 @@ class JaxTrainEngine(TrainableEngine):
         # optax evaluated the schedule at the PRE-increment count.
         applied_lr = float(self.lr_schedule(self.opt_step_count))
         self.opt_step_count += 1
+        # Engine bookkeeping keys are written AFTER the user stats and would
+        # clobber same-named loss_fn stats — keep them namespaced.
         out = dict(stats_acc)
         out["loss"] = loss_acc
         out["grad_norm"] = float(gnorm)
         out["lr"] = applied_lr
-        out["n_tokens"] = float(sum(mb.n_tokens for mb in mbs))
+        out["total_tokens"] = float(sum(mb.n_tokens for mb in mbs))
         out["loss_weight"] = total_w
         return out
 

@@ -1,0 +1,99 @@
+"""Test fixtures: mock tokenizer + fabricated datasets.
+
+Parity target: ``realhf/base/testing.py`` (tiny fabricated models + random
+WordPiece tokenizer) and ``tests/fixtures.py`` (random jsonl datasets).
+The tiny model configs live in models/config.py (tiny_config).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import List, Optional
+
+PAD_TOKEN = 0
+EOS_TOKEN = 1
+
+
+class MockTokenizer:
+    """Deterministic char-level tokenizer: byte + 2 (0 = pad, 1 = eos)."""
+
+    def __init__(self, vocab_size: int = 258):
+        self.vocab_size = vocab_size
+        self.pad_token_id = PAD_TOKEN
+        self.eos_token_id = EOS_TOKEN
+
+    def encode(self, text: str) -> List[int]:
+        return [(b % (self.vocab_size - 2)) + 2 for b in text.encode()]
+
+    def decode(self, ids) -> str:
+        return bytes(
+            max(int(i) - 2, 0) for i in ids if int(i) not in (PAD_TOKEN, EOS_TOKEN)
+        ).decode(errors="replace")
+
+    def __call__(self, texts, **kw):
+        if isinstance(texts, str):
+            texts = [texts]
+        return {"input_ids": [self.encode(t) for t in texts]}
+
+
+def make_math_jsonl(path: str, n: int = 32, seed: int = 0) -> List[dict]:
+    """Solvable arithmetic prompts with boxed ground truths."""
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        a, b = rng.randint(0, 50), rng.randint(0, 50)
+        records.append(
+            {
+                "query_id": f"q{i}",
+                "prompt": f"What is {a}+{b}? ",
+                "task": "math",
+                "solutions": [f"\\boxed{{{a + b}}}"],
+            }
+        )
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return records
+
+
+def make_sft_jsonl(path: str, n: int = 32, seed: int = 0) -> List[dict]:
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        a, b = rng.randint(0, 50), rng.randint(0, 50)
+        records.append(
+            {
+                "query_id": f"s{i}",
+                "prompt": f"What is {a}+{b}? ",
+                "answer": f"The answer is {a + b}.",
+            }
+        )
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return records
+
+
+def make_code_jsonl(path: str, n: int = 4, seed: int = 0) -> List[dict]:
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        k = rng.randint(1, 5)
+        io = {
+            "inputs": [f"{x}\n" for x in range(3)],
+            "outputs": [f"{x + k}\n" for x in range(3)],
+        }
+        records.append(
+            {
+                "query_id": f"c{i}",
+                "prompt": f"Write a program that reads x and prints x+{k}.",
+                "task": "code",
+                "solutions": [],
+                "input_output": json.dumps(io),
+            }
+        )
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return records

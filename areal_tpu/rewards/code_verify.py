@@ -1,0 +1,137 @@
+"""Local sandboxed code verification.
+
+Parity target: ``functioncall/code/local_verify.py`` + ``testing_util.py``
+(the reference's local fallback when no remote FUNCTIONCALL_SERVICE_DOMAIN
+is configured). Runs a generated python solution against the dataset's
+``input_output`` test cases in a subprocess with time/output limits.
+
+Two test-case styles (same as the reference / LiveCodeBench):
+ - stdin/stdout: inputs/outputs are raw text, the program reads stdin;
+ - fn_name: inputs are argument lists, outputs the expected return values.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("rewards.code")
+
+_CODE_BLOCK = re.compile(r"```(?:python|py)?\n(.*?)```", re.DOTALL)
+
+
+def extract_code(text: str) -> Optional[str]:
+    blocks = _CODE_BLOCK.findall(text)
+    if blocks:
+        return blocks[-1].strip()
+    if "def " in text or "print(" in text or "input(" in text:
+        return text.strip()
+    return None
+
+
+_FN_RUNNER = """
+import json, sys
+{code}
+_args = json.loads(sys.stdin.read())
+_res = {fn_name}(*_args)
+print(json.dumps(_res))
+"""
+
+
+def _run_one(
+    code: str,
+    stdin: str,
+    timeout: float,
+    fn_name: Optional[str] = None,
+) -> Tuple[bool, str]:
+    if fn_name:
+        src = _FN_RUNNER.format(code=code, fn_name=fn_name)
+    else:
+        src = code
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(src)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path],
+            input=stdin,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if proc.returncode != 0:
+            return False, proc.stderr[-500:]
+        return True, proc.stdout
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+    finally:
+        import os
+
+        os.unlink(path)
+
+
+def _outputs_match(got: str, want: str) -> bool:
+    g = [l.rstrip() for l in got.strip().splitlines()]
+    w = [l.rstrip() for l in want.strip().splitlines()]
+    if g == w:
+        return True
+    # numeric comparison fallback (whitespace/format tolerant)
+    try:
+        gn = [float(x) for x in got.split()]
+        wn = [float(x) for x in want.split()]
+        return len(gn) == len(wn) and all(
+            abs(a - b) <= 1e-6 * max(1.0, abs(b)) for a, b in zip(gn, wn)
+        )
+    except ValueError:
+        return False
+
+
+def verify_code(
+    generated: str,
+    input_output: str | Dict,
+    timeout: float = 8.0,
+    max_cases: int = 16,
+) -> float:
+    """1.0 iff the extracted program passes ALL (sampled) test cases."""
+    code = extract_code(generated)
+    if code is None:
+        return 0.0
+    io = json.loads(input_output) if isinstance(input_output, str) else input_output
+    inputs = io.get("inputs", [])
+    outputs = io.get("outputs", [])
+    fn_name = io.get("fn_name")
+    if not inputs:
+        return 0.0
+    step = max(1, len(inputs) // max_cases)
+    for inp, want in list(zip(inputs, outputs))[::step]:
+        if fn_name:
+            stdin = inp if isinstance(inp, str) else json.dumps(inp)
+            ok, got = _run_one(code, stdin, timeout, fn_name=fn_name)
+            if not ok:
+                return 0.0
+            try:
+                want_v = json.loads(want) if isinstance(want, str) else want
+                got_v = json.loads(got)
+                if got_v != want_v and not (
+                    isinstance(want_v, list) and got_v == want_v[0]
+                ):
+                    return 0.0
+            except (json.JSONDecodeError, IndexError):
+                return 0.0
+        else:
+            ok, got = _run_one(code, inp, timeout)
+            if not ok or not _outputs_match(got, want):
+                return 0.0
+    return 1.0
+
+
+def batch_verify_code(
+    pairs: List[Tuple[str, str | Dict]], timeout: float = 8.0
+) -> List[float]:
+    return [verify_code(g, io, timeout=timeout) for g, io in pairs]

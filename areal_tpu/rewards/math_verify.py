@@ -1,0 +1,146 @@
+"""Local math answer extraction + grading.
+
+Parity target: ``realhf/impl/dataset/math_parser.py`` (869 LoC) and
+``functioncall/math/verify.py:12`` — the rule-based math reward. This is a
+native reimplementation of the same contract: extract the final answer from
+a generated solution (\\boxed{}, "the answer is", last standalone math
+expression) and grade it against any of the ground-truth solutions,
+tolerant to formatting (fractions, percents, commas, units, LaTeX noise).
+"""
+
+from __future__ import annotations
+
+import re
+from fractions import Fraction
+from typing import List, Optional, Tuple
+
+__all__ = ["extract_answer", "math_equal", "verify_math", "batch_verify_math"]
+
+
+_BOXED = re.compile(r"\\boxed\s*\{")
+_ANSWER_IS = re.compile(
+    r"(?:final answer|answer)\s*(?:is|:|=)\s*\$?([^\n$]+)", re.IGNORECASE
+)
+# Trailing prose after an inline answer ("5, which is prime").
+_TRAILING_PROSE = re.compile(r"[,;]?\s+(?:which|because|since|so|as|and)\b.*$")
+
+
+def _find_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} with balanced braces."""
+    out = None
+    for m in _BOXED.finditer(text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        if depth == 0:
+            out = text[m.end() : i - 1]
+    return out
+
+
+def extract_answer(text: str) -> Optional[str]:
+    boxed = _find_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    m = None
+    for m in _ANSWER_IS.finditer(text):
+        pass
+    if m is not None:
+        ans = _TRAILING_PROSE.sub("", m.group(1))
+        return ans.strip().rstrip(".").strip()
+    # Fall back to the last number in the text.
+    nums = re.findall(r"-?\d+(?:/\d+)?(?:\.\d+)?", text)
+    return nums[-1] if nums else None
+
+
+_UNIT_WORDS = (
+    "degrees?", "percent", "dollars?", "cents?", "units?", "square", "cubic",
+    "meters?", "cm", "mm", "km", "inches", "feet", "ft", "miles?", "hours?",
+    "minutes?", "seconds?", "\\\\text\\{[^}]*\\}", "\\\\mathrm\\{[^}]*\\}",
+    "\\\\,", "\\\\!", "\\\\;", "\\\\ ",
+)
+
+
+def normalize(ans: str) -> str:
+    s = ans.strip()
+    s = re.sub(r"\\left|\\right", "", s)
+    s = re.sub(r"\\(d)?frac\s*\{([^{}]+)\}\s*\{([^{}]+)\}", r"(\2)/(\3)", s)
+    s = re.sub(r"\\frac\s*(\d)\s*(\d)", r"\1/\2", s)  # \frac12
+    s = re.sub(r"\\sqrt\s*\{([^{}]+)\}", r"sqrt(\1)", s)
+    s = re.sub(r"\\pi", "pi", s)
+    s = re.sub(r"\\cdot|\\times", "*", s)
+    s = re.sub("|".join(_UNIT_WORDS), "", s)
+    s = s.replace("\\%", "%").replace("$", "").replace("°", "")
+    s = s.replace("{", "(").replace("}", ")").replace("^", "**")
+    s = re.sub(r"(?<=\d),(?=\d{3}\b)", "", s)  # thousands separators
+    s = re.sub(r"\s+", "", s)
+    s = s.rstrip(".")
+    return s
+
+
+def _as_number(s: str) -> Optional[Fraction]:
+    s = s.strip()
+    neg = False
+    if s.startswith("(") and s.endswith(")"):
+        s = s[1:-1]
+    if s.startswith("-"):
+        neg, s = True, s[1:]
+    pct = s.endswith("%")
+    if pct:
+        s = s[:-1]
+    try:
+        m = re.fullmatch(r"\(?([^()/]+)\)?/\(?([^()/]+)\)?", s)
+        if m:
+            v = Fraction(m.group(1)) / Fraction(m.group(2))
+        else:
+            v = Fraction(s)
+    except (ValueError, ZeroDivisionError):
+        return None
+    if pct:
+        v /= 100
+    return -v if neg else v
+
+
+def math_equal(pred: str, ref: str, rel_tol: float = 1e-4) -> bool:
+    np_, nr = normalize(pred), normalize(ref)
+    if np_ == nr:
+        return True
+    vp, vr = _as_number(np_), _as_number(nr)
+    if vp is not None and vr is not None:
+        if vp == vr:
+            return True
+        denom = max(abs(float(vr)), 1e-12)
+        return abs(float(vp - vr)) / denom < rel_tol
+    # Symbolic fallback when sympy is available (kept optional).
+    try:
+        import sympy
+
+        return sympy.simplify(
+            sympy.sympify(np_.replace("sqrt", "sqrt")) - sympy.sympify(nr)
+        ) == 0
+    except Exception:
+        return False
+
+
+def verify_math(generated: str, solutions: List[str]) -> float:
+    """1.0 if the extracted answer matches ANY ground-truth solution.
+    Ground-truth entries may themselves contain \\boxed{} (full solutions)
+    or be bare answers."""
+    pred = extract_answer(generated)
+    if pred is None:
+        return 0.0
+    for sol in solutions:
+        ref = extract_answer(sol) if ("\\boxed" in sol or len(sol) > 64) else sol
+        if ref is not None and math_equal(pred, ref):
+            return 1.0
+    return 0.0
+
+
+def batch_verify_math(
+    pairs: List[Tuple[str, List[str]]],
+) -> List[float]:
+    return [verify_math(g, s) for g, s in pairs]

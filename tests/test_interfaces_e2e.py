@@ -1,0 +1,149 @@
+"""Single-process end-to-end slices (SURVEY §7 minimum slice):
+SFT loop, and sync-PPO: generate → reward → ref/critic inf → actor/critic
+train. Mirrors the reference's tests/experiments e2e suite, without the
+worker fabric (that layer gets its own tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.algorithms.ppo import (
+    PPOActorInterface,
+    PPOCriticInterface,
+    PPOHyperparameters,
+    LogprobInterface,
+    attach_keys,
+)
+from areal_tpu.algorithms.reward import MultiTaskRewardInterface
+from areal_tpu.algorithms.sft import SFTInterface
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import (
+    FinetuneSpec,
+    GenerationHyperparameters,
+    Model,
+)
+from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+from areal_tpu.base.testing import MockTokenizer, make_math_jsonl, make_sft_jsonl
+from areal_tpu.datasets.jsonl import MathCodePromptDataset, PromptAnswerDataset
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+
+MBS = MicroBatchSpec(max_tokens_per_mb=512)
+
+
+def _make_model(name, vocab=258, is_critic=False, seed=0, train=True):
+    cfg = tiny_config(vocab_size=vocab, n_layers=2, hidden_dim=32,
+                      is_critic=is_critic)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(seed))
+    model = Model(name, (cfg, params), tokenizer=MockTokenizer(vocab))
+    backend = JaxTrainBackend(
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant",
+                                  warmup_steps_proportion=0.0),
+        compute_dtype="float32", length_bucket=32, rows_bucket=2,
+        seqs_bucket=4, train=train,
+    )
+    return backend.initialize(model, FinetuneSpec(1, 64, 8))
+
+
+def test_sft_e2e(tmp_path):
+    path = tmp_path / "sft.jsonl"
+    make_sft_jsonl(str(path), n=16)
+    tok = MockTokenizer()
+    ds = PromptAnswerDataset(dataset_path=str(path), tokenizer=tok)
+    model = _make_model("sft")
+    iface = SFTInterface()
+    batch = SequenceSample.gather([ds[i] for i in range(8)])
+    first = iface.train_step(model, batch, MBS)
+    for _ in range(6):
+        last = iface.train_step(model, batch, MBS)
+    assert last["ppl"] < first["ppl"]
+    ev = iface.inference(model, batch, MBS)
+    assert "eval_nll" in ev.keys and ev.bs == 8
+
+
+@pytest.fixture()
+def math_env(tmp_path):
+    path = tmp_path / "math.jsonl"
+    make_math_jsonl(str(path), n=8)
+    tok = MockTokenizer()
+    ds = MathCodePromptDataset(dataset_path=str(path), tokenizer=tok)
+    return ds, tok, str(path)
+
+
+def test_sync_ppo_e2e(math_env):
+    ds, tok, path = math_env
+    hp = PPOHyperparameters(
+        gen=GenerationHyperparameters(max_new_tokens=8, temperature=1.0),
+        group_size=2, ppo_n_minibatches=2, kl_ctl=0.05,
+        adv_norm=True, value_norm=True,
+    )
+    actor = _make_model("actor", seed=0)
+    critic = _make_model("critic", is_critic=True, seed=1)
+    ref = _make_model("ref", seed=0, train=False)
+    rw_model = Model("rw", None, tokenizer=tok)
+
+    actor_i = PPOActorInterface(hp)
+    critic_i = PPOCriticInterface(hp)
+    ref_i = LogprobInterface()
+    rw_i = MultiTaskRewardInterface(dataset_path=path, group_size=hp.group_size)
+
+    prompts = SequenceSample.gather([ds[i] for i in range(4)])
+
+    # --- one full PPO step over the 7-node DFG, in-process ---
+    traj = actor_i.generate(actor, prompts, MBS)
+    assert traj.bs == 8  # 4 prompts × group 2
+    assert {"packed_input_ids", "prompt_mask", "packed_logprobs",
+            "seq_no_eos_mask", "version_start"} <= traj.keys
+
+    rew = rw_i.inference(rw_model, traj, MBS)
+    traj.update_(rew)
+    refs = ref_i.inference(ref, traj, MBS)
+    traj.update_(refs)
+    vals = critic_i.inference(critic, traj, MBS)
+    traj.update_(vals)
+    prox = actor_i.inference(actor, traj, MBS)
+    traj.update_(prox)
+
+    astats = actor_i.train_step(actor, traj, MBS)
+    cstats = critic_i.train_step(critic, traj, MBS)
+    assert np.isfinite(astats["actor_loss"])
+    assert np.isfinite(cstats["critic_loss"])
+    assert astats["n_action_tokens"] > 0
+    assert actor.version.global_step == 1
+
+    # behaviour == current policy ⇒ importance weight ≈ 1 on the 1st minibatch
+    assert 0.5 < astats["importance_weight"] < 2.0
+
+
+def test_ppo_decoupled_and_grpo_paths(math_env):
+    ds, tok, path = math_env
+    hp = PPOHyperparameters(
+        gen=GenerationHyperparameters(max_new_tokens=6),
+        group_size=2, ppo_n_minibatches=1,
+        disable_value=True, group_adv_norm=True, adv_norm=False,
+        use_decoupled_loss=True, behav_imp_weight_cap=10.0,
+        kl_ctl=0.0, use_adaptive_kl_ctl=True,
+    )
+    actor = _make_model("actor2", seed=2)
+    actor_i = PPOActorInterface(hp)
+    rw_i = MultiTaskRewardInterface(dataset_path=path, group_size=2)
+
+    prompts = SequenceSample.gather([ds[i] for i in range(3)])
+    traj = actor_i.generate(actor, prompts, MBS)
+    traj.update_(rw_i.inference(Model("rw", None, tokenizer=tok), traj, MBS))
+    traj.update_(actor_i.inference(actor, traj, MBS))  # prox_logprobs
+    # GRPO: no critic values anywhere
+    assert "values" not in traj.keys
+    stats = actor_i.train_step(actor, traj, MBS)
+    assert np.isfinite(stats["actor_loss"])
+
+
+def test_attach_keys_non_mutating():
+    s = SequenceSample.from_default(
+        ids=["a"], data={"packed_input_ids": np.arange(4, dtype=np.int32)},
+        seqlens=[4],
+    )
+    s2 = attach_keys(s, {"advantages": np.ones(4, np.float32)})
+    assert "advantages" in s2.keys and "advantages" not in s.keys
