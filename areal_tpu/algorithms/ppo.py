@@ -91,20 +91,26 @@ class RunningMoments:
         self.beta = beta
         self.eps = eps
         self.mean = 0.0
-        self.var = 1.0
+        self.mean_sq = 1.0
         self._initialized = False
 
     def update(self, x: np.ndarray, mask: np.ndarray) -> None:
         m = mask.astype(bool)
         if m.sum() == 0:
             return
-        bm, bv = float(x[m].mean()), float(x[m].var())
+        bm, bsq = float(x[m].mean()), float((x[m] ** 2).mean())
         if not self._initialized:
-            self.mean, self.var = bm, max(bv, self.eps)
+            self.mean, self.mean_sq = bm, bsq
             self._initialized = True
         else:
+            # EMA of mean and mean-square (reference rms.py): the variance
+            # E[x^2]-E[x]^2 then includes batch-mean drift.
             self.mean = self.beta * self.mean + (1 - self.beta) * bm
-            self.var = self.beta * self.var + (1 - self.beta) * bv
+            self.mean_sq = self.beta * self.mean_sq + (1 - self.beta) * bsq
+
+    @property
+    def var(self) -> float:
+        return max(self.mean_sq - self.mean**2, self.eps)
 
     def normalize(self, x):
         return (x - self.mean) / np.sqrt(self.var + self.eps)
@@ -114,11 +120,12 @@ class RunningMoments:
 
     def state_dict(self):
         return {
-            "mean": self.mean, "var": self.var, "initialized": self._initialized
+            "mean": self.mean, "mean_sq": self.mean_sq,
+            "initialized": self._initialized,
         }
 
     def load_state_dict(self, d):
-        self.mean, self.var = d["mean"], d["var"]
+        self.mean, self.mean_sq = d["mean"], d["mean_sq"]
         self._initialized = d["initialized"]
 
 
@@ -149,34 +156,40 @@ def compute_advantages_and_returns(
     values = g.get("values", np.zeros_like(behav)) * (g["segment_ids"] > 0)
 
     score = np.asarray(sample.data["rewards"], np.float32).reshape(-1)
-    if "seq_no_eos_mask" in sample.keys and hp.mask_no_eos_with_zero:
-        no_eos = np.asarray(sample.data["seq_no_eos_mask"]).reshape(-1) > 0
+    no_eos = (
+        np.asarray(sample.data["seq_no_eos_mask"]).reshape(-1) > 0
+        if "seq_no_eos_mask" in sample.keys
+        else np.zeros(sample.bs, bool)
+    )
+    if hp.mask_no_eos_with_zero:
         score = np.where(no_eos, 0.0, score)
     n = mb.n_seqs
-    rewards = np.asarray(
-        F.shape_rewards(
-            jnp.asarray(np.concatenate([score, np.zeros(len(mb.seq_rows) - n)])
-                        .astype(np.float32)),
-            jnp.asarray(kl),
-            jnp.asarray(amask),
-            jnp.asarray(mb.seq_last_cols),
-            jnp.asarray(mb.seq_rows),
-            kl_coef=kl_coef,
-            reward_scaling=hp.reward_output_scaling,
-            reward_bias=hp.reward_output_bias,
-            clip=hp.max_reward_clip,
-        )
+    # KL-only penalty (this IS the logged kl_rewards key, as in the
+    # reference where it is cloned BEFORE the task score lands).
+    kl_rw = (-kl_coef * kl * amask).astype(np.float32)
+    tok_score = np.clip(
+        (score - hp.reward_output_bias) * hp.reward_output_scaling,
+        -hp.max_reward_clip, hp.max_reward_clip,
+    )
+    rewards = kl_rw.copy()
+    rewards[mb.seq_rows[:n], mb.seq_last_cols[:n]] += tok_score
+    # Truncated sequences bootstrap GAE with V(s_T) at their last token
+    # (cugae "truncate" semantics; reference pygae1d bootstrap mask).
+    boot = np.zeros_like(values)
+    boot[mb.seq_rows[:n], mb.seq_last_cols[:n]] = (
+        values[mb.seq_rows[:n], mb.seq_last_cols[:n]] * no_eos
     )
     # GAE over action tokens only: restrict the segment grid to them so
     # prompt positions neither receive advantage nor relay the recursion.
     act_seg = np.where(amask, g["segment_ids"], 0)
     adv, ret = F.gae_grid(
         jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(act_seg),
+        bootstrap=jnp.asarray(boot),
         gamma=hp.discount, lam=hp.gae_lambda,
     )
     adv, ret = np.asarray(adv), np.asarray(ret)
     out = {}
-    for key, grid in (("advantages", adv), ("returns", ret), ("kl_rewards", rewards)):
+    for key, grid in (("advantages", adv), ("returns", ret), ("kl_rewards", kl_rw)):
         out[key] = np.concatenate(
             mbu.scatter_back([mb], [grid], sample.bs)
         ).astype(np.float32)
@@ -232,6 +245,7 @@ class PPOActorInterface(ModelInterface):
             )
         else:
             self.kl_ctl = F.FixedKLController(self.hp.kl_ctl)
+        self._gen_calls = 0
         hp_ = self.hp
 
         def actor_loss_fn(logits, batch):
@@ -253,11 +267,8 @@ class PPOActorInterface(ModelInterface):
                 behav_imp_weight_cap=hp_.behav_imp_weight_cap,
                 loss_scale=jnp.asarray(1.0),  # sum; engine divides by weight
             )
-            n = jnp.sum(amask)
             stats = {f"{k}_sum": v * 1.0 for k, v in st.items()}
-            stats["n_action_tokens"] = n
-            # approx KL(new ‖ behav) for the adaptive controller
-            stats["kl_sum"] = jnp.sum((batch["packed_logprobs"] - lp) * amask)
+            stats["n_action_tokens"] = jnp.sum(amask)
             return loss, stats
 
         self._loss_fn = actor_loss_fn
@@ -273,9 +284,13 @@ class PPOActorInterface(ModelInterface):
         eos = getattr(model.tokenizer, "eos_token_id", 1) or 1
         pad = getattr(model.tokenizer, "pad_token_id", 0) or 0
         gconfig = dataclasses.replace(hp.gen, n=hp.group_size)
+        # Distinct key per call even within one model version.
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(model.version.global_step), self._gen_calls
+        )
+        self._gen_calls += 1
         out = engine.generate(
-            data, mb_spec, gconfig,
-            key=jax.random.PRNGKey(model.version.global_step),
+            data, mb_spec, gconfig, key=key,
             eos_token_id=eos, pad_token_id=pad,
         )
         return trajectories_from_gen_output(
@@ -504,7 +519,6 @@ def trajectories_from_gen_output(
     plens = prompts.total_lens("packed_prompts")
     ids, seqlens = [], []
     toks, pmask, lps = [], [], []
-    rows = []
     n_eos = []
     for i in range(prompts.bs):
         prompt = prompts.data["packed_prompts"][offs[i] : offs[i] + plens[i]]
@@ -524,7 +538,6 @@ def trajectories_from_gen_output(
             lps.append(
                 np.concatenate([np.zeros(len(prompt), np.float32), g_lps])
             )
-            rows.append(r)
             # Truncated iff EOS never appeared among the emitted tokens
             # (gen_mask.all() alone misses EOS landing on the final slot).
             n_eos.append(float(eos_token_id not in g_toks))

@@ -120,6 +120,7 @@ class JaxTrainEngine(TrainableEngine):
         rows_bucket: int = 8,
         seqs_bucket: int = 8,
         attn_impl: str = "auto",
+        remat: bool = False,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -128,6 +129,7 @@ class JaxTrainEngine(TrainableEngine):
         self.rows_bucket = rows_bucket
         self.seqs_bucket = seqs_bucket
         self.attn_impl = attn_impl
+        self.remat = remat
         if mesh is not None:
             params = psh.shard_params(params, mesh, cfg)
         else:
@@ -171,6 +173,7 @@ class JaxTrainEngine(TrainableEngine):
             batch["positions"],
             segment_ids=batch["segment_ids"],
             attn_impl=self.attn_impl,
+            remat=self.remat,
         )
         return out.astype(jnp.float32)
 
@@ -363,6 +366,7 @@ class JaxTrainBackend(ModelBackend):
     rows_bucket: int = 8
     seqs_bucket: int = 8
     attn_impl: str = "auto"
+    remat: bool = False
     train: bool = True
 
     def initialize(self, model: Model, spec: FinetuneSpec) -> Model:
@@ -378,6 +382,7 @@ class JaxTrainBackend(ModelBackend):
             rows_bucket=self.rows_bucket,
             seqs_bucket=self.seqs_bucket,
             attn_impl=self.attn_impl,
+            remat=self.remat,
         )
         model.module = engine
         return model

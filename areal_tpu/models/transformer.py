@@ -186,6 +186,7 @@ def forward(
     cache_write_index: Optional[jnp.ndarray] = None,
     kv_valid: Optional[jnp.ndarray] = None,
     attn_impl: str = "auto",
+    remat: bool = False,  # rematerialize each layer in the backward pass
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Returns (output, kv) where output is logits [B, T, V] (or values [B, T]
     for critics) and kv stacks per-layer keys/values [n_layers, B, S, Hkv, Dh]
@@ -216,6 +217,10 @@ def forward(
         )
         return h2, kv
 
+    if remat and not decode:
+        # HBM-for-FLOPs trade (the reference relies on Megatron activation
+        # checkpointing; here it is one jax.checkpoint over the scan body).
+        body = jax.checkpoint(body)
     if decode:
         h, (ks, vs) = jax.lax.scan(
             body, h, (layer_params, (kv_cache["k"], kv_cache["v"]))

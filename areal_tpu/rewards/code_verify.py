@@ -24,6 +24,7 @@ from areal_tpu.base import logging
 logger = logging.getLogger("rewards.code")
 
 _CODE_BLOCK = re.compile(r"```(?:python|py)?\n(.*?)```", re.DOTALL)
+MAX_OUTPUT_BYTES = 4 * 1024 * 1024  # cap read-back of graded program output
 
 
 def extract_code(text: str) -> Optional[str]:
@@ -57,22 +58,32 @@ def _run_one(
     with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
         f.write(src)
         path = f.name
+    # Spool stdout/stderr to files so a print-flood program can't balloon
+    # the trainer host's RSS; read back capped.
+    out_f = tempfile.NamedTemporaryFile("w+", delete=False)
+    err_f = tempfile.NamedTemporaryFile("w+", delete=False)
     try:
         proc = subprocess.run(
             [sys.executable, path],
             input=stdin,
-            capture_output=True,
+            stdout=out_f,
+            stderr=err_f,
             text=True,
             timeout=timeout,
         )
+        err_f.seek(0)
         if proc.returncode != 0:
-            return False, proc.stderr[-500:]
-        return True, proc.stdout
+            return False, err_f.read(500)
+        out_f.seek(0)
+        return True, out_f.read(MAX_OUTPUT_BYTES)
     except subprocess.TimeoutExpired:
         return False, "timeout"
     finally:
         import os
 
+        for fh in (out_f, err_f):
+            fh.close()
+            os.unlink(fh.name)
         os.unlink(path)
 
 

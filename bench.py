@@ -1,0 +1,112 @@
+"""Benchmark: PPO trained-tokens/sec on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (mirrors the reference's "effective trained tokens/sec",
+benchmark/verl_v0_3_0_post1_76084d3/README.md:27-34): time full PPO actor
+train steps — micro-batched forward+backward+optimizer over packed
+variable-length trajectories — and divide the trajectory token count by
+wall clock. Model: Qwen2.5-1.5B-shaped config (BASELINE.json config #1) in
+bf16. vs_baseline is measured/analytic-roofline (MFU proxy) since the
+reference publishes no absolute tokens/sec (BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    from areal_tpu.algorithms.ppo import (
+        PPOActorInterface,
+        PPOHyperparameters,
+        attach_keys,
+    )
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import FinetuneSpec, Model
+    from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import TransformerConfig
+
+    # Qwen2.5-0.5B geometry (24 layers, d=896, 14q/2kv heads, ffn 4864) —
+    # the largest BASELINE-family model whose params+Adam+logits fit one
+    # 16G-HBM chip; multi-chip configs scale via the same engine's mesh.
+    cfg = TransformerConfig(
+        n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
+        intermediate_dim=4864, vocab_size=151936, rotary_base=1e6,
+        tie_word_embeddings=True, use_attention_bias=True, dtype="bfloat16",
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    model = Model("actor", (cfg, params), tokenizer=None)
+    backend = JaxTrainBackend(
+        optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant",
+                                  warmup_steps_proportion=0.0),
+        compute_dtype="bfloat16", length_bucket=512, rows_bucket=4,
+        seqs_bucket=16, remat=True,
+    )
+    model = backend.initialize(model, FinetuneSpec(1, 512, 64))
+
+    hp = PPOHyperparameters(ppo_n_minibatches=1, adv_norm=True,
+                            kl_ctl=0.0, disable_value=True)
+    iface = PPOActorInterface(hp)
+
+    # Synthetic rollout batch: 32 trajectories, 256-token prompt + ~768 gen.
+    rng = np.random.RandomState(0)
+    n_seq = 32
+    plens = rng.randint(200, 257, n_seq)
+    glens = rng.randint(512, 769, n_seq)
+    seqlens = (plens + glens).astype(int)
+    total = int(seqlens.sum())
+    toks = rng.randint(2, cfg.vocab_size, total).astype(np.int32)
+    pmask, lps = [], []
+    for p, g in zip(plens, glens):
+        pmask.append(np.concatenate([np.ones(p, np.int32), np.zeros(g, np.int32)]))
+        lps.append(np.concatenate([np.zeros(p, np.float32),
+                                   -rng.rand(g).astype(np.float32)]))
+    batch = SequenceSample.from_default(
+        ids=[f"b{i}" for i in range(n_seq)],
+        data={
+            "packed_input_ids": toks,
+            "prompt_mask": np.concatenate(pmask),
+            "packed_logprobs": np.concatenate(lps),
+            "rewards": rng.rand(n_seq).astype(np.float32),
+            "seq_no_eos_mask": np.zeros(n_seq, np.float32),
+        },
+        seqlens=seqlens.tolist(),
+    )
+    spec = MicroBatchSpec(max_tokens_per_mb=4096)
+
+    iface.train_step(model, batch, spec)  # warmup/compile
+    jax.block_until_ready(model.module.params)
+    t0 = time.perf_counter()
+    steps = 3
+    for _ in range(steps):
+        iface.train_step(model, batch, spec)
+    jax.block_until_ready(model.module.params)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens_per_sec_chip = steps * total / dt / n_chips
+
+    # Roofline context: analytic train FLOPs (6·N·T, llama formula family —
+    # reference realhf/base/monitor.py:288) over the bf16 peak of one chip.
+    n_params = transformer.param_count(cfg)
+    flops = 6.0 * n_params * (steps * total)
+    peak = 197e12 if "v5 lite" in str(jax.devices()[0]).lower() else 459e12
+    mfu = flops / dt / n_chips / peak
+
+    print(json.dumps({
+        "metric": "ppo_trained_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
