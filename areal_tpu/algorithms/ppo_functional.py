@@ -29,14 +29,10 @@ import numpy as np
 
 # ---------------- logprob gathering ----------------
 
-def gather_logprobs(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """log p(labels) per position. logits [B, L, V], labels [B, L] → [B, L].
-
-    Equivalent of gather_packed_shifted_log_probs (reference functional.py);
-    the shift is the caller's responsibility (labels[t] = token at t+1).
-    """
-    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+# Memory-lean CE gather shared with generation (reference
+# gather_packed_shifted_log_probs, utils/functional.py; the shift is the
+# caller's responsibility — labels[t] = token at t+1).
+from areal_tpu.ops.xent import gather_logprobs  # noqa: E402,F401  (re-export)
 
 
 def token_logprobs_from_logits(
@@ -308,24 +304,3 @@ class AdaptiveKLController:
     def update(self, current_kl: float, n_steps: int) -> None:
         err = np.clip(current_kl / self.target - 1.0, -0.2, 0.2)
         self._value *= 1.0 + err * n_steps / self.horizon
-
-
-def shape_rewards(
-    score: jnp.ndarray,  # [B] scalar task reward per sequence (row-major seq order)
-    kl: jnp.ndarray,  # [B, L] per-token KL(π_behav ‖ π_ref) estimate
-    mask: jnp.ndarray,  # [B, L] action-token mask
-    last_token_idx: jnp.ndarray,  # [B] grid column of each sequence's last token
-    row_idx: jnp.ndarray,  # [B] grid row of each sequence
-    kl_coef: float,
-    reward_scaling: float = 1.0,
-    reward_bias: float = 0.0,
-    clip: float = 20.0,
-) -> jnp.ndarray:
-    """Sparse reward shaping (reference ppo_functional.py:229-263): the task
-    score lands on each sequence's final token; −kl_coef·KL everywhere."""
-    tok_score = jnp.clip(
-        (score - reward_bias) * reward_scaling, -clip, clip
-    )
-    rewards = -kl_coef * kl * mask
-    rewards = rewards.at[row_idx, last_token_idx].add(tok_score)
-    return rewards

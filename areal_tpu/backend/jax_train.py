@@ -174,8 +174,12 @@ class JaxTrainEngine(TrainableEngine):
             segment_ids=batch["segment_ids"],
             attn_impl=self.attn_impl,
             remat=self.remat,
+            return_kv=False,
         )
-        return out.astype(jnp.float32)
+        # Critic values [B, L] are cheap in f32; lm logits [B, L, V] stay in
+        # the compute dtype — loss fns upcast per-element inside fused
+        # reductions (see ppo_functional.gather_logprobs).
+        return out.astype(jnp.float32) if self.cfg.is_critic else out
 
     def _get_grad_fn(self, loss_fn: LossFn) -> Callable:
         key = id(loss_fn)
@@ -243,8 +247,8 @@ class JaxTrainEngine(TrainableEngine):
         grad_fn = self._get_grad_fn(loss_fn)
 
         grads_acc = None
-        loss_acc = 0.0
-        stats_acc: Dict[str, float] = {}
+        loss_acc = None
+        stats_acc: Dict[str, Any] = {}
         for mb, w in zip(mbs, weights):
             denom = total_w if token_normalize_scope == "global" else w
             batch = self._device_batch(mb)
@@ -261,9 +265,11 @@ class JaxTrainEngine(TrainableEngine):
                 if grads_acc is None
                 else jax.tree.map(jnp.add, grads_acc, grads)
             )
-            loss_acc += float(loss)
+            # Keep scalars on device: a float() here would sync the host
+            # into every micro-batch and stall the pipeline.
+            loss_acc = loss if loss_acc is None else loss_acc + loss
             for k, v in stats.items():
-                stats_acc[k] = stats_acc.get(k, 0.0) + float(v)
+                stats_acc[k] = stats_acc[k] + v if k in stats_acc else v
 
         self.params, self.opt_state, gnorm = self._get_apply_fn()(
             self.params, self.opt_state, grads_acc
@@ -273,8 +279,8 @@ class JaxTrainEngine(TrainableEngine):
         self.opt_step_count += 1
         # Engine bookkeeping keys are written AFTER the user stats and would
         # clobber same-named loss_fn stats — keep them namespaced.
-        out = dict(stats_acc)
-        out["loss"] = loss_acc
+        out = {k: float(v) for k, v in stats_acc.items()}
+        out["loss"] = float(loss_acc) if loss_acc is not None else 0.0
         out["grad_norm"] = float(gnorm)
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(mb.n_tokens for mb in mbs))

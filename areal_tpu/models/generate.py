@@ -58,10 +58,12 @@ def generate_batch(
     logits, kv = forward(
         params, cfg, prompts, positions, segment_ids=seg, attn_impl=attn_impl
     )
-    # Log-probs of prompt tokens (teacher-forced), for optional prompt scoring.
-    lp_all = jax.nn.log_softmax(logits, axis=-1)
+    # Log-probs of prompt tokens (teacher-forced), for optional prompt
+    # scoring — gather + fused logsumexp, no [B, P, V] f32 copy (ops/xent).
+    from areal_tpu.ops.xent import gather_logprobs
+
     nxt = jnp.concatenate([prompts[:, 1:], prompts[:, :1]], axis=1)
-    prompt_logprobs = jnp.take_along_axis(lp_all, nxt[..., None], axis=-1)[..., 0]
+    prompt_logprobs = gather_logprobs(logits, nxt)
 
     # Pad per-layer KV to the full decode length.
     kv_cache = init_kv_cache(cfg, B, S, dtype=kv["k"].dtype)

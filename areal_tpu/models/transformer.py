@@ -187,7 +187,8 @@ def forward(
     kv_valid: Optional[jnp.ndarray] = None,
     attn_impl: str = "auto",
     remat: bool = False,  # rematerialize each layer in the backward pass
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    return_kv: bool = True,  # False in training: don't stack per-layer K/V
+) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Returns (output, kv) where output is logits [B, T, V] (or values [B, T]
     for critics) and kv stacks per-layer keys/values [n_layers, B, S, Hkv, Dh]
     (S = T in packed mode, the cache length in decode mode).
@@ -215,7 +216,7 @@ def forward(
             cfg, h, lp, cos, sin, segment_ids, positions,
             None, None, None, attn_impl,
         )
-        return h2, kv
+        return h2, (kv if return_kv else None)
 
     if remat and not decode:
         # HBM-for-FLOPs trade (the reference relies on Megatron activation
@@ -225,8 +226,11 @@ def forward(
         h, (ks, vs) = jax.lax.scan(
             body, h, (layer_params, (kv_cache["k"], kv_cache["v"]))
         )
-    else:
+    elif return_kv:
         h, (ks, vs) = jax.lax.scan(body, h, layer_params)
+    else:
+        h, _ = jax.lax.scan(body, h, layer_params)
+        ks = vs = None
 
     h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
     lg = "logits" if not decode else "logits_decode"
@@ -236,7 +240,7 @@ def forward(
         out = constrain(h @ params["embedding"].T, lg)
     else:
         out = constrain(h @ params["lm_head"], lg)
-    return out, {"k": ks, "v": vs}
+    return out, ({"k": ks, "v": vs} if ks is not None else None)
 
 
 def init_kv_cache(

@@ -1,0 +1,100 @@
+"""TPU flash attention for packed segment batches.
+
+Role parity: the reference's flash-attn varlen path
+(``realhf/impl/model/modules/attn.py:24-27``). The hot op is delegated to
+JAX's Pallas TPU flash-attention kernel
+(``jax.experimental.pallas.ops.tpu.flash_attention``) — block-streamed
+online-softmax with fused forward/backward kernels — wrapped here with
+areal_tpu's packed-batch semantics:
+
+ - inputs are [B, T, H, D] (time-major heads-minor, the model layout);
+ - GQA: kv heads are expanded to the q head count before the kernel (the
+   kernel wants matching head counts; the expansion is O(B·S·Hq·D) HBM but
+   keeps the inner loop dense on the MXU);
+ - document masking via SegmentIds — block-causal by grid column, which
+   equals per-document causal order because packing keeps documents
+   contiguous within a row (models/packing.py);
+ - head_dim is padded up to the lane width (128) when needed.
+
+CPU/testing: wrap calls in ``pltpu.force_tpu_interpret_mode()`` — the parity
+test (tests/test_pallas_attention.py) runs the same kernel interpreted.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes,
+    SegmentIds,
+)
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    flash_attention as _jax_flash,
+)
+
+LANE = 128
+
+
+def _block(n: int, target: int) -> int:
+    """Largest divisor-friendly block ≤ target for a dimension of size n."""
+    return min(target, n)
+
+
+@functools.partial(
+    jax.named_call, name="pallas_flash_attention"
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, Hq, D]
+    k: jnp.ndarray,  # [B, S, Hkv, D]
+    v: jnp.ndarray,  # [B, S, Hkv, D]
+    q_segment_ids: jnp.ndarray,  # [B, T] int, 0 = pad
+    kv_segment_ids: jnp.ndarray,  # [B, S]
+    q_positions: Optional[jnp.ndarray] = None,  # accepted for API parity
+    kv_positions: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    B, T, Hq, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if Hq != Hkv:
+        G = Hq // Hkv
+        k = jnp.repeat(k, G, axis=2)
+        v = jnp.repeat(v, G, axis=2)
+    if scale is None:
+        scale = D ** -0.5
+
+    # [B, T, H, D] → [B, H, T, D] kernel layout.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if D < LANE:
+        pad = [(0, 0), (0, 0), (0, 0), (0, LANE - D)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+
+    # Padding rows (segment id 0) must not alias into a real segment; the
+    # kernel's segment mask handles it as long as pad ids differ between a
+    # q pad and kv real token — id 0 == id 0 would attend pad→pad only,
+    # which is harmless (output rows for pad queries are discarded), but we
+    # keep them NaN-free by masking afterwards instead.
+    seg = SegmentIds(q=q_segment_ids, kv=kv_segment_ids)
+
+    bq = _block(T, 512)
+    bkv = _block(S, 512)
+    sizes = BlockSizes(
+        block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkv,
+        block_k_dkv=bkv, block_q_dkv=bq,
+        block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq,
+    )
+    out = _jax_flash(
+        qt, kt, vt, segment_ids=seg, causal=causal, sm_scale=scale,
+        block_sizes=sizes,
+    )
+    if D < LANE:
+        out = out[..., :D]
+    out = out.transpose(0, 2, 1, 3)
+    # Zero pad-query rows (the kernel leaves them unspecified-but-finite).
+    return out * (q_segment_ids > 0)[:, :, None, None].astype(out.dtype)

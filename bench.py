@@ -25,7 +25,6 @@ def main():
     from areal_tpu.algorithms.ppo import (
         PPOActorInterface,
         PPOHyperparameters,
-        attach_keys,
     )
     from areal_tpu.api.data import MicroBatchSpec, SequenceSample
     from areal_tpu.api.model import FinetuneSpec, Model
@@ -97,8 +96,13 @@ def main():
     # reference realhf/base/monitor.py:288) over the bf16 peak of one chip.
     n_params = transformer.param_count(cfg)
     flops = 6.0 * n_params * (steps * total)
-    peak = 197e12 if "v5 lite" in str(jax.devices()[0]).lower() else 459e12
-    mfu = flops / dt / n_chips / peak
+    kind = str(jax.devices()[0]).lower()
+    peaks = {  # bf16 peak FLOP/s per chip
+        "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
+    }
+    peak = next((v for k, v in peaks.items() if k in kind), None)
+    mfu = (flops / dt / n_chips / peak) if peak else 0.0
 
     print(json.dumps({
         "metric": "ppo_trained_tokens_per_sec_per_chip",

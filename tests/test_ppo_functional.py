@@ -134,23 +134,3 @@ def test_kl_controllers():
     a2 = F.AdaptiveKLController(init_kl_coef=0.1, target=1.0, horizon=100)
     a2.update(0.1, 10)  # below target → shrinks
     assert a2.value < 0.1
-
-
-def test_shape_rewards_places_score_at_last_token():
-    seqlens = [3, 2]
-    layout, grid, _ = _grid_from_packed(
-        seqlens, np.zeros(5, np.float32), row_len=8
-    )
-    mask = jnp.asarray(grid["segment_ids"] > 0)
-    kl = jnp.ones(layout.shape) * 0.5
-    rows = jnp.asarray([p[0] for p in layout.placements])
-    lasts = jnp.asarray(
-        [p[1] + n - 1 for p, n in zip(layout.placements, layout.seqlens)]
-    )
-    r = F.shape_rewards(
-        jnp.asarray([1.0, -1.0]), kl, mask, lasts, rows, kl_coef=0.1,
-    )
-    r = np.asarray(r)
-    # last tokens: score − kl penalty; others: just −kl penalty
-    np.testing.assert_allclose(r[0, 2], 1.0 - 0.05, atol=1e-6)
-    np.testing.assert_allclose(r[0, 0], -0.05, atol=1e-6)
