@@ -1,0 +1,43 @@
+"""Agent and environment abstractions for rollout workers.
+
+Parity targets: ``realhf/api/core/agent_api.py:15`` (queue-based
+``Agent.collect_trajectory(prompt, env, obs_queue, act_queue)``) and
+``realhf/api/core/env_api.py:8`` (``EnvironmentService.step/reset``).
+The queue indirection decouples agent logic from the inference transport:
+the rollout worker feeds obs_queue → generation client, and generation
+outputs → act_queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Tuple
+
+from areal_tpu.api.data import SequenceSample
+
+
+class EnvironmentService:
+    async def reset(self, seed: int = 0) -> Any:
+        return None
+
+    async def step(self, action: Any) -> Tuple[Any, float, bool, dict]:
+        raise NotImplementedError()
+
+
+class NullEnvironment(EnvironmentService):
+    async def step(self, action):
+        return None, 0.0, True, {}
+
+
+class Agent:
+    async def collect_trajectory(
+        self,
+        prompt: SequenceSample,
+        env: EnvironmentService,
+        obs_queue: asyncio.Queue,
+        act_queue: asyncio.Queue,
+    ) -> List[SequenceSample]:
+        """Put generation requests on obs_queue, await grouped outputs from
+        act_queue, interact with env for rewards, return trajectory samples
+        (possibly empty when filtered)."""
+        raise NotImplementedError()

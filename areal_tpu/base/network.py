@@ -28,6 +28,12 @@ def gethostip() -> str:
         return "127.0.0.1"
 
 
+def bind_addr() -> str:
+    """Interface to bind servers on (all interfaces; peers connect via
+    gethostip())."""
+    return "0.0.0.0"
+
+
 def find_free_port(lockfile_root: str | None = None) -> int:
     """Find a free TCP port. When ``lockfile_root`` is given, takes an flock on
     a per-port lockfile so concurrent processes on one host don't race."""

@@ -39,8 +39,12 @@ LANE = 128
 
 
 def _block(n: int, target: int) -> int:
-    """Largest divisor-friendly block ≤ target for a dimension of size n."""
-    return min(target, n)
+    """Largest multiple of 128 that divides n and is ≤ target (the kernel
+    requires block sizes to divide the sequence dims exactly)."""
+    for b in range(min(target, n), 0, -LANE):
+        if n % b == 0 and b % LANE == 0:
+            return b
+    raise NotImplementedError(f"no 128-multiple block divides {n}")
 
 
 @functools.partial(
@@ -59,6 +63,11 @@ def flash_attention(
 ) -> jnp.ndarray:
     B, T, Hq, D = q.shape
     S, Hkv = k.shape[1], k.shape[2]
+    if T % LANE or S % LANE:
+        raise NotImplementedError(
+            f"flash kernel needs 128-aligned sequence dims, got T={T} S={S} "
+            "(the packing length_bucket guarantees this for training shapes)"
+        )
     if Hq != Hkv:
         G = Hq // Hkv
         k = jnp.repeat(k, G, axis=2)

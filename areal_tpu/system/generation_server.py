@@ -1,0 +1,234 @@
+"""TPU generation server — the SGLang/JetStream role, in-house.
+
+Parity target: ``realhf/system/generation_server.py`` + the sglang patch
+(``patch/sglang/v0.4.6.post4.patch``: interruptible generation, weight
+update from disk). TPU-first design differences:
+
+ - **Chunked decoding replaces interruption.** The reference patches SGLang
+   to abort in-flight requests when weights update. Here every ``/generate``
+   call decodes AT MOST ``chunk_tokens`` new tokens as one static-shape
+   ``lax.scan`` and returns a partial result tagged with the weight version
+   that produced it; the client (PartialRolloutManager) re-submits with the
+   accumulated prefix. Weight updates therefore wait at most one chunk —
+   the same bound the reference achieves by aborting, with zero lost work
+   and no recompilation (chunk length is static).
+ - **Micro-batched continuous batching**: concurrent requests are drained
+   from a queue every ``batch_window_ms`` and decoded together, padded to
+   bucketed prompt lengths (prefix re-prefill per chunk; a paged KV cache
+   across chunks is a later optimization).
+ - ``/update_weights`` hot-swaps params in place (device_put over the old
+   sharding) from the trainer's published checkpoint (§3.5 disk path).
+
+Endpoints: POST /generate, POST /update_weights, GET /health, GET /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.models import generate as genmod
+from areal_tpu.models import transformer  # noqa: F401 (engine deps)
+
+logger = logging.getLogger("system.genserver")
+
+
+@dataclasses.dataclass
+class GenerationServerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    server_id: str = "gen0"
+    chunk_tokens: int = 128  # static decode length per /generate call
+    batch_window_ms: int = 5
+    max_batch_size: int = 64
+    prompt_bucket: int = 128
+    eos_token_id: int = 1
+    pad_token_id: int = 0
+    port: Optional[int] = None
+
+
+class _Pending:
+    __slots__ = ("prompt", "gconfig", "future", "max_tokens")
+
+    def __init__(self, prompt, gconfig, max_tokens, future):
+        self.prompt = prompt
+        self.gconfig = gconfig
+        self.max_tokens = max_tokens
+        self.future = future
+
+
+class GenerationServer:
+    """Owns (cfg, params) of the serving model; hot-swappable."""
+
+    def __init__(self, cfg: GenerationServerConfig, model_cfg, params,
+                 mesh=None):
+        self.cfg = cfg
+        self.model_cfg = model_cfg
+        import jax
+
+        if mesh is not None:
+            from areal_tpu.parallel import sharding as psh
+
+            params = psh.shard_params(params, mesh, model_cfg)
+        else:
+            params = jax.tree.map(jax.numpy.asarray, params)
+        self.params = params
+        self.mesh = mesh
+        self.version = 0
+        self._queue: asyncio.Queue = None  # created on loop start
+        self._key = jax.random.PRNGKey(0)
+        self._tokens_out = 0
+        self._t_start = time.monotonic()
+        self._runner_task = None
+
+    # ---------------- decode core ----------------
+
+    def _decode_batch(self, batch: List[_Pending]) -> List[Dict[str, Any]]:
+        import jax
+
+        cfg = self.cfg
+        chunk = min(cfg.chunk_tokens, max(p.max_tokens for p in batch))
+        prompts = [p.prompt for p in batch]
+        padded, plens = genmod.pad_prompts(
+            prompts, cfg.pad_token_id, bucket=cfg.prompt_bucket
+        )
+        self._key, sub = jax.random.split(self._key)
+        gconfig = batch[0].gconfig  # sampling params are per-batch v1
+        out = genmod.generate_batch(
+            self.params, self.model_cfg, padded, plens, sub,
+            gconfig, max_new_tokens=chunk,
+            eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
+        )
+        res = []
+        for i, p in enumerate(batch):
+            n = int(out["output_lens"][i])
+            toks = np.asarray(out["output_ids"][i][:n])
+            lps = np.asarray(out["output_logprobs"][i][:n])
+            # "finished" = the MODEL ended the sequence (EOS). Budget
+            # exhaustion is the client's call — it knows the total budget
+            # across chunks, we only see this chunk's slice.
+            emitted_eos = bool((toks == cfg.eos_token_id).any())
+            res.append({
+                "output_ids": toks.tolist(),
+                "output_logprobs": lps.tolist(),
+                "finished": emitted_eos,
+                "version": self.version,
+            })
+            self._tokens_out += n
+        return res
+
+    async def _runner(self):
+        cfg = self.cfg
+        while True:
+            first: _Pending = await self._queue.get()
+            batch = [first]
+            await asyncio.sleep(cfg.batch_window_ms / 1000)
+            while len(batch) < cfg.max_batch_size and not self._queue.empty():
+                batch.append(self._queue.get_nowait())
+            try:
+                results = await asyncio.to_thread(self._decode_batch, batch)
+                for p, r in zip(batch, results):
+                    p.future.set_result(r)
+            except Exception as e:  # noqa: BLE001 — propagate per-request
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+
+    # ---------------- http ----------------
+
+    async def handle_generate(self, request):
+        from aiohttp import web
+
+        d = await request.json()
+        gconfig = GenerationHyperparameters(**d.get("gconfig", {}))
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(_Pending(
+            prompt=np.asarray(d["prompt_ids"], np.int32),
+            gconfig=gconfig,
+            max_tokens=int(d.get("max_tokens", gconfig.max_new_tokens)),
+            future=fut,
+        ))
+        return web.json_response(await fut)
+
+    async def handle_update_weights(self, request):
+        import jax
+
+        from areal_tpu.models import hf as hfmod
+
+        d = await request.json()
+        t0 = time.monotonic()
+        cfg2, params = hfmod.load_hf_checkpoint(d["path"])
+        # Preserve the existing per-leaf device placement/sharding.
+        new = jax.tree.map(
+            lambda old, npv: jax.device_put(
+                np.asarray(npv, dtype=old.dtype), old.sharding
+            ),
+            self.params,
+            params,
+        )
+        self.params = new
+        self.version = int(d.get("version", self.version + 1))
+        dt = time.monotonic() - t0
+        logger.info(f"weights updated to v{self.version} in {dt:.2f}s")
+        from aiohttp import web
+
+        return web.json_response({"ok": True, "version": self.version,
+                                  "latency_s": dt})
+
+    async def handle_health(self, request):
+        from aiohttp import web
+
+        return web.json_response({"ok": True, "version": self.version})
+
+    async def handle_metrics(self, request):
+        from aiohttp import web
+
+        dt = max(time.monotonic() - self._t_start, 1e-6)
+        return web.json_response({
+            "generated_tokens": self._tokens_out,
+            "tokens_per_sec": self._tokens_out / dt,
+            "version": self.version,
+        })
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/generate", self.handle_generate)
+        app.router.add_post("/update_weights", self.handle_update_weights)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    async def start(self) -> str:
+        """Start serving; registers the URL under names.gen_servers."""
+        from aiohttp import web
+
+        self._queue = asyncio.Queue()
+        self._runner_task = asyncio.create_task(self._runner())
+        app = self.build_app()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = self.cfg.port or network.find_free_port()
+        site = web.TCPSite(runner, network.bind_addr(), port)
+        await site.start()
+        url = f"http://{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.gen_servers(self.cfg.experiment, self.cfg.trial,
+                              self.cfg.server_id),
+            url, replace=True,
+        )
+        logger.info(f"generation server {self.cfg.server_id} at {url}")
+        self._runner_obj = runner
+        return url
+
+    async def stop(self):
+        if self._runner_task:
+            self._runner_task.cancel()
+        await self._runner_obj.cleanup()

@@ -1,0 +1,207 @@
+"""Generation-server manager — routing, staleness gate, weight fanout.
+
+Parity target: ``realhf/system/gserver_manager.py:32`` — the singleton
+rollout controller: HTTP router over the generation-server fleet
+(round-robin / least-requests), the **staleness gate** that blocks new
+rollouts when they would be too off-policy, ``/finish_rollout`` accounting,
+and the weight-update fanout (watch ``names.model_version``, POST
+``/update_weights`` to every server, GC old realloc dirs).
+
+Staleness rule (reference ``is_staled`` :351):
+    expected_version = (trained_samples + running) // train_batch_size
+    allowed  iff  expected_version <= max_head_offpolicyness + current_version
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("system.gserver_mgr")
+
+
+@dataclasses.dataclass
+class GserverManagerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    model_role: str = "actor"
+    n_servers: int = 1
+    train_batch_size: int = 8
+    max_head_offpolicyness: int = 0
+    max_concurrent_rollouts: int = 64
+    schedule_policy: str = "round_robin"  # or least_requests
+    realloc_dir: str = "/tmp/areal_tpu/realloc"
+    weight_poll_secs: float = 1.0
+    port: Optional[int] = None
+    keep_last_versions: int = 2
+
+
+class GserverManager:
+    def __init__(self, cfg: GserverManagerConfig):
+        self.cfg = cfg
+        self.servers: List[str] = []
+        self.version = 0
+        self._rr = 0
+        self._inflight: Dict[str, int] = {}  # url -> outstanding requests
+        self.running_rollouts = 0
+        self.accepted_rollouts = 0  # trained samples submitted
+        self._watcher_task = None
+
+    # ---------------- discovery ----------------
+
+    async def wait_for_servers(self, timeout: float = 300.0):
+        deadline = time.monotonic() + timeout
+        root = names.gen_server_root(self.cfg.experiment, self.cfg.trial)
+        while time.monotonic() < deadline:
+            urls = sorted(name_resolve.get_subtree(root))
+            if len(urls) >= self.cfg.n_servers:
+                self.servers = urls
+                self._inflight = {u: 0 for u in urls}
+                logger.info(f"found {len(urls)} generation servers")
+                return
+            await asyncio.sleep(0.2)
+        raise TimeoutError("generation servers did not register")
+
+    # ---------------- scheduling ----------------
+
+    def _pick_server(self) -> str:
+        if self.cfg.schedule_policy == "least_requests":
+            return min(self.servers, key=lambda u: self._inflight[u])
+        url = self.servers[self._rr % len(self.servers)]
+        self._rr += 1
+        return url
+
+    def is_staled(self) -> bool:
+        expected = (
+            self.accepted_rollouts + self.running_rollouts
+        ) // max(self.cfg.train_batch_size, 1)
+        return expected > self.cfg.max_head_offpolicyness + self.version
+
+    # ---------------- http handlers ----------------
+
+    async def handle_schedule_request(self, request):
+        from aiohttp import web
+
+        url = self._pick_server()
+        self._inflight[url] += 1
+        return web.json_response({"url": url, "version": self.version})
+
+    async def handle_release(self, request):
+        from aiohttp import web
+
+        d = await request.json()
+        u = d.get("url")
+        if u in self._inflight and self._inflight[u] > 0:
+            self._inflight[u] -= 1
+        return web.json_response({"ok": True})
+
+    async def handle_allocate_rollout(self, request):
+        from aiohttp import web
+
+        if self.running_rollouts >= self.cfg.max_concurrent_rollouts:
+            return web.json_response({"allowed": False, "reason": "capacity"})
+        if self.is_staled():
+            return web.json_response({"allowed": False, "reason": "staleness"})
+        self.running_rollouts += 1
+        return web.json_response({"allowed": True, "version": self.version})
+
+    async def handle_finish_rollout(self, request):
+        from aiohttp import web
+
+        d = await request.json()
+        self.running_rollouts = max(0, self.running_rollouts - 1)
+        if d.get("accepted"):
+            self.accepted_rollouts += int(d.get("n_samples", 1))
+        return web.json_response({"ok": True})
+
+    async def handle_get_model_version(self, request):
+        from aiohttp import web
+
+        return web.json_response({"version": self.version})
+
+    # ---------------- weight-update fanout ----------------
+
+    async def _watch_weights(self):
+        import aiohttp
+
+        key = names.model_version(
+            self.cfg.experiment, self.cfg.trial, self.cfg.model_role
+        )
+        while True:
+            try:
+                v = int(name_resolve.get(key))
+            except Exception:  # noqa: BLE001 — key not yet published
+                v = self.version
+            if v > self.version:
+                path = os.path.join(
+                    self.cfg.realloc_dir, self.cfg.model_role, str(v)
+                )
+                t0 = time.monotonic()
+                async with aiohttp.ClientSession() as sess:
+                    await asyncio.gather(*[
+                        sess.post(f"{u}/update_weights",
+                                  json={"path": path, "version": v})
+                        for u in self.servers
+                    ])
+                self.version = v
+                logger.info(
+                    f"fanned out weights v{v} to {len(self.servers)} servers "
+                    f"in {time.monotonic() - t0:.2f}s"
+                )
+                self._gc_old_versions(v)
+            await asyncio.sleep(self.cfg.weight_poll_secs)
+
+    def _gc_old_versions(self, current: int):
+        root = os.path.join(self.cfg.realloc_dir, self.cfg.model_role)
+        if not os.path.isdir(root):
+            return
+        for d in os.listdir(root):
+            try:
+                v = int(d)
+            except ValueError:
+                continue
+            if v <= current - self.cfg.keep_last_versions:
+                shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+    # ---------------- lifecycle ----------------
+
+    def build_app(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/schedule_request", self.handle_schedule_request)
+        app.router.add_post("/release", self.handle_release)
+        app.router.add_post("/allocate_rollout", self.handle_allocate_rollout)
+        app.router.add_post("/finish_rollout", self.handle_finish_rollout)
+        app.router.add_get("/get_model_version", self.handle_get_model_version)
+        return app
+
+    async def start(self) -> str:
+        from aiohttp import web
+
+        await self.wait_for_servers()
+        self._watcher_task = asyncio.create_task(self._watch_weights())
+        runner = web.AppRunner(self.build_app())
+        await runner.setup()
+        port = self.cfg.port or network.find_free_port()
+        site = web.TCPSite(runner, network.bind_addr(), port)
+        await site.start()
+        url = f"http://{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.gen_server_manager(self.cfg.experiment, self.cfg.trial),
+            url, replace=True,
+        )
+        logger.info(f"gserver manager at {url}")
+        self._runner_obj = runner
+        return url
+
+    async def stop(self):
+        if self._watcher_task:
+            self._watcher_task.cancel()
+        await self._runner_obj.cleanup()

@@ -1,0 +1,229 @@
+"""Master worker — owns the training loop and DFG traversal.
+
+Parity target: ``realhf/system/master_worker.py:49`` +
+``function_executor.py:24`` + ``model_function_call.py:54``: per training
+step, spawn one asyncio task per MFC plus a data-loading task; each MFC
+task blocks on the metadata buffer until its input keys are ready for
+n_seqs samples, dispatches the call to the trainer over ZMQ, and amends the
+buffer with the outputs. Save/eval frequency control via timeutil; epoch
+accounting from the trainer's fetch replies.
+
+TPU-first simplifications: no DP dispatch/redistribution planning (the
+trainer is one SPMD process — GSPMD does the sharding the reference's
+RedistribPlanner computed), and requests go to a single trainer handler per
+model role group.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.api.dfg import (
+    DataFlowGraph,
+    MFCDef,
+    MFCInterfaceType,
+    ParamReallocHook,
+    WeightUpdateHook,
+)
+from areal_tpu.base import logging
+from areal_tpu.base.stats_tracker import StatsTracker
+from areal_tpu.base.timeutil import FrequencyControl
+from areal_tpu.system.buffer import AsyncSequenceBuffer
+from areal_tpu.system.streams import MasterRequestStream, Payload
+
+logger = logging.getLogger("system.master")
+
+
+@dataclasses.dataclass
+class ExperimentSaveEvalControl:
+    """Reference cli_args.py:702."""
+
+    total_train_epochs: int = 1
+    benchmark_steps: Optional[int] = None  # stop after N train steps
+    save_freq_steps: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = None
+    ckpt_freq_secs: Optional[int] = None
+    eval_freq_steps: Optional[int] = None
+
+
+@dataclasses.dataclass
+class MasterWorkerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    trainer_handler: str = "trainer"
+    train_batch_size: int = 8
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    save_dir: str = "/tmp/areal_tpu/ckpt"
+    # async mode: generation happens outside the DFG (rollout workers)
+    src_is_stream: bool = False
+
+
+class MasterWorker:
+    def __init__(self, cfg: MasterWorkerConfig, dfg: DataFlowGraph):
+        self.cfg = cfg
+        self.dfg = dfg
+        # Every node reads its inputs from the buffer once.
+        self.buffer = AsyncSequenceBuffer(n_rpcs_reading=len(dfg.nodes))
+        self.stream: Optional[MasterRequestStream] = None
+        self.step = 0
+        self.epoch = 0
+        self._train_nodes = [
+            n for n in dfg.nodes.values()
+            if n.interface_type == MFCInterfaceType.TRAIN_STEP
+        ]
+        self._gen_nodes = [
+            n for n in dfg.nodes.values()
+            if n.interface_type == MFCInterfaceType.GENERATE
+        ]
+        self.stats = StatsTracker()
+        self._save_ctl = FrequencyControl(
+            freq_step=cfg.exp_ctrl.save_freq_steps,
+        )
+        self._ckpt_ctl = FrequencyControl(
+            freq_step=cfg.exp_ctrl.ckpt_freq_steps,
+            freq_sec=cfg.exp_ctrl.ckpt_freq_secs,
+        )
+        self._stats_history: List[Dict[str, float]] = []
+
+    # ---------------- setup ----------------
+
+    def setup(self) -> None:
+        self.stream = MasterRequestStream(
+            self.cfg.experiment, self.cfg.trial, [self.cfg.trainer_handler]
+        )
+
+    # ---------------- per-step DFG traversal ----------------
+
+    async def _load_data(self) -> None:
+        """Fetch a batch from the trainer's dataset/stream into the buffer."""
+        reply = await asyncio.to_thread(
+            self.stream.call, self.cfg.trainer_handler, "fetch",
+            self.cfg.train_batch_size,
+        )
+        meta: SequenceSample = reply["meta"]
+        self.epoch = reply["epoch"]
+        self._dataset_size = reply["dataset_size"]
+        singles = [meta.select_idx([i]) for i in range(meta.bs)]
+        await self.buffer.put_batch(singles)
+
+    def _hook_dicts(self, node: MFCDef, post: bool) -> List[Dict]:
+        out = []
+        for h in node.post_hooks if post else node.pre_hooks:
+            if isinstance(h, WeightUpdateHook):
+                out.append({"kind": "weight_update", "role": h.role})
+            elif isinstance(h, ParamReallocHook):
+                out.append({
+                    "kind": "param_realloc", "source": h.source,
+                    "target": h.target, "eta": h.eta,
+                })
+        return out
+
+    async def _run_mfc(self, node: MFCDef) -> None:
+        metas = await self.buffer.get_batch_for_rpc(
+            node.name, set(node.input_keys), node.n_seqs
+        )
+        ids = [m.ids[0] for m in metas]
+        payload = Payload(
+            handler=self.cfg.trainer_handler,
+            handle_name="mfc",
+            data={
+                "mfc": node.name,
+                "ids": ids,
+                "method": node.interface_type.value,
+                "input_keys": list(node.input_keys),
+                "input_remap": node.input_key_remap,
+                "output_remap": node.output_key_remap,
+            },
+            mb_spec=node.mb_spec,
+            pre_hooks=self._hook_dicts(node, post=False),
+            post_hooks=self._hook_dicts(node, post=True),
+        )
+        rid = self.stream.post(payload)
+        reply = (await asyncio.to_thread(self.stream.gather, [rid]))[0]
+        out = reply.output
+        if node.interface_type == MFCInterfaceType.TRAIN_STEP:
+            if out["stats"]:
+                self.stats.scalar(**{
+                    f"{node.name}/{k}": v for k, v in out["stats"].items()
+                })
+        elif node.interface_type == MFCInterfaceType.GENERATE:
+            # Trajectories replace the prompt slots (flattened groups).
+            new_meta: SequenceSample = out["meta"]
+            await self.buffer.drop_ids(ids)
+            singles = [new_meta.select_idx([i]) for i in range(new_meta.bs)]
+            await self.buffer.put_batch(singles)
+            await self.buffer.mark_read(
+                [s.ids[0] for s in singles], node.name
+            )
+        else:
+            if out["meta"] is not None:
+                await self.buffer.amend_batch(out["meta"])
+
+    async def _execute_step(self) -> None:
+        tasks = [self._load_data()]
+        tasks += [self._run_mfc(n) for n in self.dfg.nodes.values()]
+        await asyncio.gather(*tasks)
+
+    # ---------------- main loop ----------------
+
+    def should_stop(self) -> bool:
+        ctrl = self.cfg.exp_ctrl
+        if ctrl.benchmark_steps is not None and self.step >= ctrl.benchmark_steps:
+            return True
+        return self.epoch >= ctrl.total_train_epochs
+
+    def run(self) -> Dict[str, Any]:
+        # One event loop for the whole experiment: the buffer's asyncio
+        # primitives bind to the loop that first touches them.
+        return asyncio.run(self._run_async())
+
+    async def _run_async(self) -> Dict[str, Any]:
+        self.setup()
+        t_start = time.monotonic()
+        while not self.should_stop():
+            t0 = time.monotonic()
+            await self._execute_step()
+            self.step += 1
+            step_stats = self.stats.export(reset=True)
+            step_stats["timeperf/e2e"] = time.monotonic() - t0
+            self._stats_history.append(step_stats)
+            logger.info(
+                f"step {self.step} epoch {self.epoch} "
+                f"({step_stats['timeperf/e2e']:.2f}s): "
+                + " ".join(
+                    f"{k}={v:.4g}" for k, v in sorted(step_stats.items())
+                    if "/" in k
+                )
+            )
+            if self._save_ctl.check(epochs=0, steps=1):
+                await asyncio.to_thread(self._request_save)
+            # post-step GC of consumed data on the trainer
+            await asyncio.to_thread(
+                self.stream.call, self.cfg.trainer_handler, "clear", []
+            )
+        total = time.monotonic() - t_start
+        logger.info(f"experiment complete: {self.step} steps in {total:.1f}s")
+        await asyncio.to_thread(
+            self.stream.call, self.cfg.trainer_handler, "exit"
+        )
+        return {"steps": self.step, "stats": self._stats_history}
+
+    def _request_save(self) -> None:
+        rids = [
+            self.stream.post(Payload(
+                handler=self.cfg.trainer_handler, handle_name="mfc",
+                data={"mfc": node.name, "ids": [], "method": "noop"},
+                post_hooks=[{
+                    "kind": "save", "role": node.model_name,
+                    "path": f"{self.cfg.save_dir}/{node.model_name}/step{self.step}",
+                }],
+            ))
+            for node in self._train_nodes
+        ]
+        self.stream.gather(rids)

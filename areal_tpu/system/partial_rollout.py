@@ -1,0 +1,163 @@
+"""Chunked interruptible generation client with per-token version tracking.
+
+Parity target: ``realhf/system/partial_rollout.py:29``
+(PartialRolloutManager): split each generation into chunks so weight
+updates only ever interrupt a chunk; carry accumulated tokens + logprobs
+across chunks; sticky-route to the same server while the version is
+unchanged; group ``group_size`` samples per prompt into one bundle with
+``version_start``/``version_end`` per sample (the decoupled-loss inputs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.base import logging
+
+logger = logging.getLogger("system.partial_rollout")
+
+
+@dataclasses.dataclass
+class GenResult:
+    output_ids: List[int]
+    output_logprobs: List[float]
+    version_start: int
+    version_end: int
+    n_chunks: int
+
+
+class PartialRolloutClient:
+    """Async client: one ``generate`` = N chunked HTTP calls routed through
+    the gserver manager."""
+
+    def __init__(self, manager_url: str, session, chunk_tokens: int = 128):
+        self.manager_url = manager_url
+        self.session = session  # aiohttp.ClientSession
+        self.chunk_tokens = chunk_tokens
+
+    async def _schedule(self) -> Dict:
+        async with self.session.post(
+            f"{self.manager_url}/schedule_request", json={}
+        ) as r:
+            return await r.json()
+
+    async def _release(self, url: str) -> None:
+        await self.session.post(f"{self.manager_url}/release",
+                                json={"url": url})
+
+    async def generate_one(
+        self,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        eos_token_id: int = 1,
+    ) -> GenResult:
+        acc_ids: List[int] = []
+        acc_lps: List[float] = []
+        version_start: Optional[int] = None
+        version_end = 0
+        n_chunks = 0
+        sticky: Optional[Dict] = None
+        while len(acc_ids) < gconfig.max_new_tokens:
+            # sticky routing while version unchanged (reference :181)
+            route = sticky or await self._schedule()
+            url = route["url"]
+            left = gconfig.max_new_tokens - len(acc_ids)
+            body = {
+                "prompt_ids": list(prompt_ids) + acc_ids,
+                "gconfig": {
+                    **dataclasses.asdict(gconfig),
+                    "max_new_tokens": min(self.chunk_tokens, left),
+                    "n": 1,
+                },
+                "max_tokens": min(self.chunk_tokens, left),
+            }
+            try:
+                async with self.session.post(f"{url}/generate",
+                                             json=body) as r:
+                    out = await r.json()
+            finally:
+                if sticky is None:
+                    await self._release(url)
+            n_chunks += 1
+            acc_ids += list(out["output_ids"])
+            acc_lps += list(out["output_logprobs"])
+            v = int(out["version"])
+            if version_start is None:
+                version_start = v
+            if v == route.get("version", v):
+                sticky = route
+            else:
+                sticky = None
+            version_end = v
+            if out["finished"] or not out["output_ids"]:
+                break
+        return GenResult(
+            output_ids=acc_ids,
+            output_logprobs=acc_lps,
+            version_start=version_start or 0,
+            version_end=version_end,
+            n_chunks=n_chunks,
+        )
+
+    async def generate_group(
+        self,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        group_size: int,
+        eos_token_id: int = 1,
+    ) -> List[GenResult]:
+        import asyncio
+
+        return list(await asyncio.gather(*[
+            self.generate_one(prompt_ids, gconfig, eos_token_id)
+            for _ in range(group_size)
+        ]))
+
+
+def trajectory_from_gen(
+    qid: str,
+    j: int,
+    prompt_ids: np.ndarray,
+    res: GenResult,
+    task: str = "math",
+    task_id: int = 0,
+    eos_token_id: int = 1,
+):
+    """One flattened trajectory SequenceSample from a chunked generation
+    (same key layout as algorithms.ppo.trajectories_from_gen_output)."""
+    import time as _time
+
+    from areal_tpu.api.data import SequenceSample
+
+    gl = max(len(res.output_ids), 1)
+    toks = np.concatenate([
+        prompt_ids, np.asarray(res.output_ids[:gl], np.int32)
+    ]) if res.output_ids else np.concatenate([prompt_ids, [eos_token_id]])
+    P = len(prompt_ids)
+    gl = len(toks) - P
+    lps = np.concatenate([
+        np.zeros(P, np.float32),
+        np.asarray((res.output_logprobs + [0.0])[:gl], np.float32),
+    ])
+    no_eos = float(eos_token_id not in toks[P:])
+    return SequenceSample.from_default(
+        ids=[f"{qid}@{j}"],
+        data={
+            "packed_input_ids": toks.astype(np.int32),
+            "prompt_mask": np.concatenate([
+                np.ones(P, np.int32), np.zeros(gl, np.int32)
+            ]),
+            "packed_logprobs": lps,
+            "seq_no_eos_mask": np.asarray([no_eos], np.float32),
+            "task_ids": np.asarray([task_id], np.int32),
+            "version_start": np.asarray([res.version_start], np.int32),
+            "version_end": np.asarray([res.version_end], np.int32),
+            "birth_time": np.asarray([_time.time()], np.float64),
+        },
+        seqlens=[len(toks)],
+        metadata={"group": [qid], "task": [task]},
+    )

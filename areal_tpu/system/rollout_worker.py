@@ -1,0 +1,159 @@
+"""Rollout worker — CPU async driver of agents against the generation fleet.
+
+Parity target: ``realhf/system/rollout_worker.py:43``: owns a dataset
+shard; for each prompt asks the gserver manager for rollout quota
+(``/allocate_rollout`` — the staleness gate), runs
+``agent.collect_trajectory`` with obs/act queues bridged to the chunked
+generation client (partial_rollout.py), pushes accepted trajectories to the
+trainer over the ZMQ push stream, and reports ``/finish_rollout``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import areal_tpu.agents  # noqa: F401 — registers built-in agents/envs
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.api.model import GenerationHyperparameters, make_agent
+from areal_tpu.base import logging, name_resolve, names
+from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl, load_shuffle_split
+from areal_tpu.system.partial_rollout import (
+    PartialRolloutClient,
+    trajectory_from_gen,
+)
+from areal_tpu.system.streams import ZmqPusher
+
+logger = logging.getLogger("system.rollout")
+
+
+@dataclasses.dataclass
+class RolloutWorkerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    worker_index: int = 0
+    n_workers: int = 1
+    dataset_path: str = ""
+    trainer_handler: str = "trainer"  # puller name to push to
+    agent: str = "math_single_step"
+    agent_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    group_size: int = 1
+    chunk_tokens: int = 128
+    max_concurrent: int = 8
+    eos_token_id: int = 1
+    seed: int = 1
+    tokenizer: Any = None
+    max_rollouts: Optional[int] = None  # stop after N (tests); None = forever
+
+
+class RolloutWorker:
+    def __init__(self, cfg: RolloutWorkerConfig):
+        self.cfg = cfg
+        records = load_jsonl(cfg.dataset_path)
+        self.records = load_shuffle_split(
+            records, cfg.seed, cfg.worker_index, cfg.n_workers
+        )
+        self.id2info = {str(d["query_id"]): d for d in self.records}
+        self.agent = make_agent(
+            cfg.agent, tokenizer=cfg.tokenizer, **cfg.agent_args
+        )
+        from areal_tpu.agents.math_single_step import MathCodeSingleStepEnv
+
+        self.env = MathCodeSingleStepEnv(self.id2info)
+        self._done = 0
+        self._pushed = 0
+
+    def _prompt_sample(self, rec) -> SequenceSample:
+        ids = self.cfg.tokenizer.encode(rec["prompt"])
+        return SequenceSample.from_default(
+            ids=[str(rec["query_id"])],
+            data={"packed_prompts": np.asarray(ids, np.int32)},
+            seqlens=[len(ids)],
+            metadata={"task": [rec.get("task", "math")]},
+        )
+
+    async def _rollout_one(self, rec, client, pusher, mgr_url, session):
+        cfg = self.cfg
+        # quota / staleness gate
+        async with session.post(f"{mgr_url}/allocate_rollout", json={}) as r:
+            alloc = await r.json()
+        if not alloc.get("allowed"):
+            await asyncio.sleep(0.5)
+            return False
+        accepted = 0
+        try:
+            prompt = self._prompt_sample(rec)
+            obs_q: asyncio.Queue = asyncio.Queue()
+            act_q: asyncio.Queue = asyncio.Queue()
+            task = asyncio.create_task(
+                self.agent.collect_trajectory(prompt, self.env, obs_q, act_q)
+            )
+            qid, prompt_ids, _ = await obs_q.get()
+            results = await client.generate_group(
+                list(map(int, prompt_ids)), cfg.gconfig, cfg.group_size,
+                eos_token_id=cfg.eos_token_id,
+            )
+            rec_task = rec.get("task", "math")
+            trajs = [
+                trajectory_from_gen(
+                    qid, j, np.asarray(prompt_ids, np.int32), res,
+                    task=rec_task, task_id=RL_TASKS.index(rec_task),
+                    eos_token_id=cfg.eos_token_id,
+                )
+                for j, res in enumerate(results)
+            ]
+            await act_q.put(trajs)
+            final = await task
+            for t in final:
+                pusher.push(t.as_json_compatible())
+            accepted = len(final)
+            self._pushed += accepted
+        finally:
+            await session.post(
+                f"{mgr_url}/finish_rollout",
+                json={"accepted": accepted > 0, "n_samples": accepted},
+            )
+        self._done += 1
+        return True
+
+    async def run_async(self) -> None:
+        import aiohttp
+
+        cfg = self.cfg
+        mgr_url = name_resolve.wait(
+            names.gen_server_manager(cfg.experiment, cfg.trial), timeout=300
+        )
+        pusher = ZmqPusher(cfg.experiment, cfg.trial, cfg.trainer_handler)
+        async with aiohttp.ClientSession() as session:
+            client = PartialRolloutClient(
+                mgr_url, session, chunk_tokens=cfg.chunk_tokens
+            )
+            sem = asyncio.Semaphore(cfg.max_concurrent)
+            pos = 0
+
+            async def one(rec):
+                async with sem:
+                    await self._rollout_one(rec, client, pusher, mgr_url,
+                                            session)
+
+            pending = set()
+            while cfg.max_rollouts is None or self._done < cfg.max_rollouts:
+                while len(pending) < cfg.max_concurrent:
+                    rec = self.records[pos % len(self.records)]
+                    pos += 1
+                    pending.add(asyncio.create_task(one(rec)))
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for d in done:
+                    d.result()  # surface exceptions
+        logger.info(f"rollout worker done: {self._pushed} trajectories pushed")
+
+    def run(self) -> None:
+        asyncio.run(self.run_async())

@@ -1,0 +1,223 @@
+"""ZMQ control/data streams.
+
+Parity targets:
+ - ``realhf/system/request_reply_stream.py`` (master↔trainer RPC with named
+   handlers, request batching, async gather) — here ROUTER/DEALER instead of
+   PUB/SUB+syn/ack: ZMQ's ROUTER gives per-peer addressing and queueing for
+   free, so the handshake layer disappears;
+ - ``realhf/system/push_pull_stream.py`` (bounded PUSH/PULL rollout→trainer
+   trajectory stream with name-resolve discovery) — msgpack on the wire
+   (numpy arrays as raw bytes) instead of JSON.
+
+Control-plane payloads are pickled (trusted intra-cluster traffic, same
+trust model as the reference); the data plane (tensors) never crosses these
+sockets — the trainer's data store keeps them process-local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import zmq
+
+from areal_tpu.base import logging, name_resolve, network
+
+logger = logging.getLogger("system.streams")
+
+
+def req_reply_addr_key(experiment: str, trial: str, handler: str) -> str:
+    return f"areal_tpu/{experiment}/{trial}/req_reply/{handler}"
+
+
+def push_pull_addr_key(experiment: str, trial: str, puller: str) -> str:
+    return f"areal_tpu/{experiment}/{trial}/push_pull/{puller}"
+
+
+@dataclasses.dataclass
+class Payload:
+    handler: str  # target worker name
+    handle_name: str  # e.g. "generate"/"inference"/"train_step"/"fetch"
+    request_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    data: Any = None  # SequenceSample metadata / small control values
+    mb_spec: Any = None
+    # pre/post hooks executed by the worker around the MFC
+    # (param realloc / save / eval / offload; reference request_reply:47)
+    pre_hooks: List[Dict] = dataclasses.field(default_factory=list)
+    post_hooks: List[Dict] = dataclasses.field(default_factory=list)
+    output: Any = None
+    exception: Optional[str] = None
+
+
+class MasterRequestStream:
+    """Master-side: one DEALER per handler, addresses from name_resolve."""
+
+    def __init__(self, experiment: str, trial: str, handlers: Sequence[str],
+                 timeout: float = 300.0):
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+        self._pending: Dict[str, Payload] = {}
+        for h in handlers:
+            addr = name_resolve.wait(
+                req_reply_addr_key(experiment, trial, h), timeout=timeout
+            )
+            s = self._ctx.socket(zmq.DEALER)
+            s.connect(addr)
+            self._socks[h] = s
+        self._poller = zmq.Poller()
+        for s in self._socks.values():
+            self._poller.register(s, zmq.POLLIN)
+
+    def post(self, p: Payload) -> str:
+        self._socks[p.handler].send(pickle.dumps(p))
+        self._pending[p.request_id] = p
+        return p.request_id
+
+    def _drain(self, timeout_ms: int) -> None:
+        for sock, _ in self._poller.poll(timeout_ms):
+            reply: Payload = pickle.loads(sock.recv())
+            self._pending[reply.request_id] = reply
+
+    def gather(self, request_ids: Sequence[str],
+               timeout: float = 3600.0) -> List[Payload]:
+        """Blocking gather; raises on worker-side exception."""
+        deadline = time.monotonic() + timeout
+        out: Dict[str, Payload] = {}
+        while len(out) < len(request_ids):
+            for rid in request_ids:
+                p = self._pending.get(rid)
+                if p is not None and (p.output is not None or p.exception):
+                    out[rid] = self._pending.pop(rid)
+            if len(out) >= len(request_ids):
+                break
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"gather timed out; got {len(out)}")
+            self._drain(int(min(left, 0.2) * 1000))
+        for p in out.values():
+            if p.exception:
+                raise RuntimeError(
+                    f"worker {p.handler} failed on {p.handle_name}: {p.exception}"
+                )
+        return [out[rid] for rid in request_ids]
+
+    def call(self, handler: str, handle_name: str, data: Any = None,
+             **kw) -> Any:
+        rid = self.post(Payload(handler=handler, handle_name=handle_name,
+                                data=data, **kw))
+        return self.gather([rid])[0].output
+
+    def close(self):
+        for s in self._socks.values():
+            s.close(linger=0)
+
+
+class WorkerRequestServer:
+    """Worker-side ROUTER bound on a free port, registered in name_resolve."""
+
+    def __init__(self, experiment: str, trial: str, handler: str):
+        self.handler = handler
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        host = network.gethostip()
+        port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
+        name_resolve.add(
+            req_reply_addr_key(experiment, trial, handler),
+            f"tcp://{host}:{port}",
+            replace=True,
+        )
+        self._peer_of: Dict[str, bytes] = {}
+
+    def poll(self, timeout_ms: int = 0) -> Optional[Payload]:
+        if not self._sock.poll(timeout_ms):
+            return None
+        ident, raw = self._sock.recv_multipart()
+        p: Payload = pickle.loads(raw)
+        self._peer_of[p.request_id] = ident
+        return p
+
+    def reply(self, p: Payload) -> None:
+        ident = self._peer_of.pop(p.request_id)
+        self._sock.send_multipart([ident, pickle.dumps(p)])
+
+    def close(self):
+        self._sock.close(linger=0)
+
+
+# ---------------- push/pull (rollout → trainer) ----------------
+
+
+def _pack(obj: Any) -> bytes:
+    import msgpack
+
+    def default(o):
+        if isinstance(o, np.ndarray):
+            return {
+                "__nd__": True, "dtype": str(o.dtype), "shape": o.shape,
+                "data": o.tobytes(),
+            }
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        raise TypeError(f"cannot pack {type(o)}")
+
+    return msgpack.packb(obj, default=default)
+
+
+def _unpack(raw: bytes) -> Any:
+    import msgpack
+
+    def hook(o):
+        if o.get("__nd__"):
+            return np.frombuffer(o["data"], dtype=o["dtype"]).reshape(o["shape"])
+        return o
+
+    return msgpack.unpackb(raw, object_hook=hook, strict_map_key=False)
+
+
+class ZmqPuller:
+    def __init__(self, experiment: str, trial: str, name: str,
+                 capacity: int = 16384):
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PULL)
+        self._sock.setsockopt(zmq.RCVHWM, capacity)
+        host = network.gethostip()
+        port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
+        name_resolve.add(
+            push_pull_addr_key(experiment, trial, name),
+            f"tcp://{host}:{port}", replace=True,
+        )
+
+    def pull(self, timeout_ms: int = 0) -> Optional[Any]:
+        if not self._sock.poll(timeout_ms):
+            return None
+        return _unpack(self._sock.recv())
+
+    def close(self):
+        self._sock.close(linger=0)
+
+
+class ZmqPusher:
+    """Discovers the puller via name_resolve (reference
+    NameResolvingZmqPusher:141)."""
+
+    def __init__(self, experiment: str, trial: str, puller: str,
+                 capacity: int = 16384, timeout: float = 300.0):
+        addr = name_resolve.wait(
+            push_pull_addr_key(experiment, trial, puller), timeout=timeout
+        )
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUSH)
+        self._sock.setsockopt(zmq.SNDHWM, capacity)
+        self._sock.connect(addr)
+
+    def push(self, obj: Any) -> None:
+        self._sock.send(_pack(obj))
+
+    def close(self):
+        self._sock.close(linger=0)

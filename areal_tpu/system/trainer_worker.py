@@ -1,0 +1,350 @@
+"""Trainer worker — hosts model roles on one mesh and executes MFCs.
+
+Parity target: ``realhf/system/model_worker.py:101``. TPU-first collapse:
+JAX is single-controller SPMD, so the reference's one-process-per-GPU model
+workers (with NCCL data redistribution between them, ``data_manager.py``,
+``redistributor.py``) become ONE process driving the whole trainer mesh —
+the DataManager shrinks to an in-process dict, and GSPMD handles every
+intra-mesh reshard the reference planned centrally.
+
+Serves the master's request stream with handlers:
+ - ``fetch``          next dataset batch → store → metadata
+ - ``mfc``            run one MFC (generate/inference/train_step) over
+                      stored samples; store outputs; reply metadata
+ - ``clear``          drop sample ids from the store
+ - ``save`` / ``version`` / ``exit``  bookkeeping
+
+Pre/post hooks on MFC payloads: ``weight_update`` publishes actor weights
+for the generation fleet (disk path + names.model_version bump — §3.5 of
+the survey), ``param_realloc`` does EMA role sync, ``save`` checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import (
+    FinetuneSpec,
+    Model,
+    make_backend,
+    make_dataset,
+    make_interface,
+)
+from areal_tpu.base import logging, name_resolve, names
+from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
+
+logger = logging.getLogger("system.trainer")
+
+
+@dataclasses.dataclass
+class ModelRoleConfig:
+    """One model role (actor/critic/ref/reward) hosted by the trainer."""
+
+    # model construction: "hf_dir" (path) or "init" (cfg dict) or "shared"
+    init: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    backend: str = "jax_train"
+    backend_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    train: bool = True
+
+
+@dataclasses.dataclass
+class MFCRuntimeConfig:
+    """Interface binding for one MFC name."""
+
+    interface: str = "ppo_actor"
+    interface_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    model_name: str = "actor"
+    method: str = "train_step"
+
+
+@dataclasses.dataclass
+class TrainerWorkerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    handler: str = "trainer"
+    models: Dict[str, ModelRoleConfig] = dataclasses.field(default_factory=dict)
+    mfcs: Dict[str, MFCRuntimeConfig] = dataclasses.field(default_factory=dict)
+    dataset: Optional[str] = None
+    dataset_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: int = 8
+    ft_spec: FinetuneSpec = dataclasses.field(default_factory=FinetuneSpec)
+    tokenizer: Any = None
+    # async mode: pull trajectories from rollout workers instead of a dataset
+    stream_dataset: bool = False
+    realloc_dir: str = "/tmp/areal_tpu/realloc"
+
+
+class TrainerWorker:
+    def __init__(self, cfg: TrainerWorkerConfig, model_factory=None):
+        """``model_factory(role, role_cfg) -> Model`` lets tests inject tiny
+        models; the default builds from role_cfg.init (hf dir / config)."""
+        self.cfg = cfg
+        self.store: Dict[Any, SequenceSample] = {}
+        self.models: Dict[str, Model] = {}
+        self.interfaces: Dict[str, Any] = {}
+        self._mfc_cfg = cfg.mfcs
+        self._server: Optional[WorkerRequestServer] = None
+        self._dataset = None
+        self._data_iter: List[int] = []
+        self._epoch = 0
+        self._epoch_pos = 0
+        self._puller: Optional[ZmqPuller] = None
+        self._pull_q: "queue.Queue[SequenceSample]" = queue.Queue()
+        self._pull_thread = None
+        self._model_factory = model_factory or self._default_model_factory
+        self._exiting = False
+
+    # ---------------- setup ----------------
+
+    @staticmethod
+    def _default_model_factory(role: str, rc: ModelRoleConfig) -> Model:
+        from areal_tpu.models import hf as hfmod
+
+        if "hf_dir" in rc.init:
+            cfg, params, tok = hfmod.load_hf_model(rc.init["hf_dir"])
+            return Model(role, (cfg, params), tokenizer=tok)
+        if "ckpt_dir" in rc.init:
+            cfg, params = hfmod.load_hf_checkpoint(rc.init["ckpt_dir"])
+            return Model(role, (cfg, params))
+        if "tiny" in rc.init:  # fabricated test model (reference testing.py)
+            import jax
+
+            from areal_tpu.models import transformer
+            from areal_tpu.models.config import tiny_config
+
+            kw = dict(rc.init["tiny"])
+            seed = kw.pop("seed", 0)
+            cfg = tiny_config(**kw)
+            params = transformer.init_params(cfg, jax.random.PRNGKey(seed))
+            return Model(role, (cfg, params))
+        if rc.init.get("null"):  # tokenizer-only role (rule-based reward)
+            return Model(role, None)
+        raise ValueError(f"role {role}: no model source in init={rc.init}")
+
+    def setup(self) -> None:
+        cfg = self.cfg
+        for role, rc in cfg.models.items():
+            model = self._model_factory(role, rc)
+            if model.tokenizer is None:
+                model.tokenizer = cfg.tokenizer
+            if rc.backend == "null" or model.module is None:
+                self.models[role] = model
+                continue
+            backend = make_backend(rc.backend, **{"train": rc.train,
+                                                 **rc.backend_args})
+            self.models[role] = backend.initialize(model, cfg.ft_spec)
+        for mfc_name, mc in self._mfc_cfg.items():
+            self.interfaces[mfc_name] = make_interface(
+                mc.interface, **mc.interface_args
+            )
+        if cfg.dataset is not None:
+            self._dataset = make_dataset(
+                cfg.dataset, tokenizer=cfg.tokenizer, **cfg.dataset_args
+            )
+            self._reshuffle()
+        if cfg.stream_dataset:
+            self._puller = ZmqPuller(cfg.experiment, cfg.trial, cfg.handler)
+            self._pull_thread = threading.Thread(
+                target=self._pull_loop, daemon=True
+            )
+            self._pull_thread.start()
+        self._server = WorkerRequestServer(
+            cfg.experiment, cfg.trial, cfg.handler
+        )
+        logger.info(
+            f"trainer up: models={list(self.models)} mfcs={list(self.interfaces)}"
+        )
+
+    def _reshuffle(self):
+        rng = np.random.RandomState(self._epoch + 1)
+        self._data_iter = list(rng.permutation(len(self._dataset)))
+        self._epoch_pos = 0
+
+    def _pull_loop(self):
+        while not self._exiting:
+            obj = self._puller.pull(timeout_ms=200)
+            if obj is not None:
+                self._pull_q.put(SequenceSample.from_json_compatible(obj))
+
+    # ---------------- handlers ----------------
+
+    def _handle_fetch(self, p: Payload) -> Any:
+        n = int(p.data or self.cfg.batch_size)
+        if self.cfg.stream_dataset:
+            out: List[SequenceSample] = []
+            while len(out) < n:
+                try:
+                    out.append(self._pull_q.get(timeout=0.5))
+                except queue.Empty:
+                    if out:
+                        break  # partial batch is fine in async mode
+                    continue
+            batch = SequenceSample.gather(out)
+        else:
+            idx = []
+            while len(idx) < n and self._dataset is not None:
+                if self._epoch_pos >= len(self._data_iter):
+                    self._epoch += 1
+                    self._reshuffle()
+                idx.append(self._data_iter[self._epoch_pos])
+                self._epoch_pos += 1
+            batch = SequenceSample.gather([self._dataset[i] for i in idx])
+        for i in range(batch.bs):
+            s = batch.select_idx([i])
+            self.store[s.ids[0]] = s
+        return {
+            "meta": batch.meta(),
+            "epoch": self._epoch,
+            "epoch_pos": self._epoch_pos,
+            "dataset_size": len(self._dataset) if self._dataset else -1,
+        }
+
+    def _gather_input(self, ids, input_keys, remap) -> SequenceSample:
+        samples = [self.store[i] for i in ids]
+        batch = SequenceSample.gather(samples)
+        if remap:
+            batch = SequenceSample(
+                ids=list(batch.ids), keys=set(batch.keys),
+                seqlens=dict(batch.seqlens), data=dict(batch.data),
+                metadata=dict(batch.metadata),
+            )
+            batch.remap_keys_(remap)
+        return batch
+
+    def _handle_mfc(self, p: Payload) -> Any:
+        req = p.data  # {"mfc": name, "ids": [...], "method": ...}
+        if req.get("method") == "noop":
+            # hook-only request (e.g. a save triggered by the master)
+            for hook in p.pre_hooks + p.post_hooks:
+                self._run_hook(hook)
+            return {"stats": None, "meta": None}
+        mfc_name = req["mfc"]
+        mc = self._mfc_cfg[mfc_name]
+        iface = self.interfaces[mfc_name]
+        model = self.models[mc.model_name]
+        batch = self._gather_input(req["ids"], req.get("input_keys"),
+                                   req.get("input_remap"))
+        mb_spec = p.mb_spec or MicroBatchSpec()
+        method = req.get("method", mc.method)
+        for hook in p.pre_hooks:
+            self._run_hook(hook)
+        out = getattr(iface, method)(model, batch, mb_spec)
+        result: Dict[str, Any] = {"stats": None, "meta": None}
+        if method == "train_step":
+            result["stats"] = out
+        elif out is not None:
+            remap = req.get("output_remap") or {}
+            if remap:
+                out.remap_keys_(remap)
+            if method == "generate":
+                # Flattened trajectories REPLACE the prompt samples.
+                for i in range(out.bs):
+                    s = out.select_idx([i])
+                    self.store[s.ids[0]] = s
+                for old_id in req["ids"]:
+                    self.store.pop(old_id, None)
+            else:
+                for i, sid in enumerate(out.ids):
+                    self.store[sid].update_(out.select_idx([i]))
+            result["meta"] = out.meta()
+        for hook in p.post_hooks:
+            self._run_hook(hook)
+        return result
+
+    def _run_hook(self, hook: Dict) -> None:
+        kind = hook.get("kind")
+        if kind == "weight_update":
+            self.publish_weights(hook.get("role", "actor"))
+        elif kind == "save":
+            role = hook.get("role", "actor")
+            self._save_role(role, hook["path"])
+        elif kind == "param_realloc":
+            # EMA: target := eta*source + (1-eta)*target (reference ref-EMA)
+            import jax
+
+            src = self.models[hook["source"]].module
+            dst = self.models[hook["target"]].module
+            eta = float(hook.get("eta", 1.0))
+            dst.params = jax.tree.map(
+                lambda s, d: (eta * s.astype(np.float32)
+                              + (1 - eta) * d.astype(np.float32)).astype(d.dtype),
+                src.params, dst.params,
+            )
+        else:
+            raise ValueError(f"unknown hook {hook}")
+
+    def _save_role(self, role: str, path: str) -> None:
+        import jax
+
+        from areal_tpu.models import hf as hfmod
+
+        model = self.models[role]
+        engine = model.module
+        hfmod.save_hf_checkpoint(
+            jax.device_get(engine.params), engine.cfg, path,
+            meta={"version": model.version.global_step},
+        )
+
+    def publish_weights(self, role: str) -> None:
+        """The §3.5 weight-sync path: save HF-format weights under the
+        realloc dir and bump names.model_version."""
+        model = self.models[role]
+        version = model.version.global_step
+        path = os.path.join(self.cfg.realloc_dir, role, str(version))
+        self._save_role(role, path)
+        name_resolve.add(
+            names.model_version(self.cfg.experiment, self.cfg.trial, role),
+            str(version), replace=True,
+        )
+        logger.info(f"published {role} weights v{version} -> {path}")
+
+    def _handle_clear(self, p: Payload) -> Any:
+        for sid in p.data or []:
+            self.store.pop(sid, None)
+        return {"n_stored": len(self.store)}
+
+    # ---------------- loop ----------------
+
+    def serve_once(self, timeout_ms: int = 100) -> bool:
+        p = self._server.poll(timeout_ms)
+        if p is None:
+            return False
+        try:
+            if p.handle_name == "fetch":
+                p.output = self._handle_fetch(p)
+            elif p.handle_name == "mfc":
+                p.output = self._handle_mfc(p)
+            elif p.handle_name == "clear":
+                p.output = self._handle_clear(p)
+            elif p.handle_name == "version":
+                p.output = {
+                    r: m.version.global_step for r, m in self.models.items()
+                }
+            elif p.handle_name == "exit":
+                p.output = "bye"
+                self._exiting = True
+            else:
+                raise ValueError(f"unknown handle {p.handle_name}")
+        except Exception as e:  # noqa: BLE001 — surfaced to the master
+            import traceback
+
+            p.exception = f"{e}\n{traceback.format_exc()}"
+            logger.error(f"handler {p.handle_name} failed: {p.exception}")
+        self._server.reply(p)
+        return True
+
+    def run(self) -> None:
+        self.setup()
+        while not self._exiting:
+            self.serve_once(timeout_ms=100)
+        if self._server:
+            self._server.close()
+        if self._puller:
+            self._puller.close()

@@ -1,0 +1,160 @@
+"""Async rollout stack test: generation server + gserver manager + rollout
+worker + chunked generation with version accounting and the staleness gate.
+(The CPU analogue of the reference's tests/system/test_gserver_manager.py +
+test_partial_rollout.py.)"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.model import GenerationHyperparameters
+from areal_tpu.base import name_resolve, names
+from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+from areal_tpu.models import hf as hfmod
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.system.generation_server import (
+    GenerationServer,
+    GenerationServerConfig,
+)
+from areal_tpu.system.gserver_manager import GserverManager, GserverManagerConfig
+from areal_tpu.system.rollout_worker import RolloutWorker, RolloutWorkerConfig
+from areal_tpu.system.streams import ZmqPuller
+
+EXP, TRIAL = "asynctest", "t0"
+
+
+@pytest.fixture()
+def env(tmp_path):
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(
+        str(tmp_path / "nr")
+    )
+    data_path = str(tmp_path / "math.jsonl")
+    make_math_jsonl(data_path, n=6)
+    cfg = tiny_config(vocab_size=258, n_layers=2, hidden_dim=32)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return data_path, cfg, params, str(tmp_path / "realloc")
+
+
+@pytest.mark.timeout(300)
+def test_async_rollout_stack(env):
+    data_path, mcfg, params, realloc_dir = env
+
+    async def main():
+        server = GenerationServer(
+            GenerationServerConfig(
+                experiment=EXP, trial=TRIAL, server_id="gen0",
+                chunk_tokens=4, prompt_bucket=16, batch_window_ms=2,
+            ),
+            mcfg, params,
+        )
+        await server.start()
+        mgr = GserverManager(GserverManagerConfig(
+            experiment=EXP, trial=TRIAL, n_servers=1,
+            train_batch_size=4, max_head_offpolicyness=100,
+            realloc_dir=realloc_dir, weight_poll_secs=0.2,
+        ))
+        await mgr.start()
+
+        puller = ZmqPuller(EXP, TRIAL, "trainer")
+        worker = RolloutWorker(RolloutWorkerConfig(
+            experiment=EXP, trial=TRIAL, dataset_path=data_path,
+            gconfig=GenerationHyperparameters(max_new_tokens=10),
+            group_size=2, chunk_tokens=4, max_concurrent=3,
+            tokenizer=MockTokenizer(), max_rollouts=4,
+            agent_args={"success_rate_lb": 0.0, "success_rate_ub": 1.0},
+        ))
+        await worker.run_async()
+
+        # trajectories arrived over the push stream
+        from areal_tpu.api.data import SequenceSample
+
+        got = []
+        for _ in range(200):
+            obj = puller.pull(timeout_ms=50)
+            if obj is None and got:
+                break
+            if obj is not None:
+                got.append(SequenceSample.from_json_compatible(obj))
+        # ≥ 4 rollouts × group 2 (in-flight rollouts may also complete)
+        assert len(got) >= 8 and len(got) % 2 == 0
+        t = got[0]
+        assert {"packed_input_ids", "prompt_mask", "packed_logprobs",
+                "rewards", "version_start", "version_end",
+                "seq_no_eos_mask"} <= t.keys
+        # chunked: multi-chunk generations happened (max_new_tokens=10, chunk 4)
+        glens = [
+            int((np.asarray(s.data["prompt_mask"]) == 0).sum()) for s in got
+        ]
+        assert max(glens) > 4  # at least one crossed a chunk boundary
+        assert all(
+            int(s.data["version_start"][0]) == 0
+            and int(s.data["version_end"][0]) == 0
+            for s in got
+        )
+
+        # ---- weight update fanout ----
+        hfmod.save_hf_checkpoint(
+            jax.device_get(server.params), mcfg,
+            os.path.join(realloc_dir, "actor", "1"), meta={"version": 1},
+        )
+        name_resolve.add(
+            names.model_version(EXP, TRIAL, "actor"), "1", replace=True
+        )
+        for _ in range(50):
+            if server.version == 1:
+                break
+            await asyncio.sleep(0.1)
+        assert server.version == 1 and mgr.version == 1
+
+        await mgr.stop()
+        await server.stop()
+        puller.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.timeout(120)
+def test_staleness_gate(env):
+    data_path, mcfg, params, realloc_dir = env
+
+    async def main():
+        server = GenerationServer(
+            GenerationServerConfig(experiment=EXP, trial=TRIAL,
+                                   server_id="gen0"),
+            mcfg, params,
+        )
+        await server.start()
+        mgr = GserverManager(GserverManagerConfig(
+            experiment=EXP, trial=TRIAL, n_servers=1,
+            train_batch_size=2, max_head_offpolicyness=1,
+        ))
+        await mgr.start()
+        import aiohttp
+
+        url = name_resolve.get(names.gen_server_manager(EXP, TRIAL))
+        async with aiohttp.ClientSession() as s:
+            allowed = 0
+            while True:
+                async with s.post(f"{url}/allocate_rollout", json={}) as r:
+                    d = await r.json()
+                if not d["allowed"]:
+                    assert d["reason"] == "staleness"
+                    break
+                allowed += 1
+                # report as accepted → counts toward staleness
+                async with s.post(f"{url}/finish_rollout",
+                                  json={"accepted": True, "n_samples": 1}):
+                    pass
+                assert allowed < 50
+            # (offpolicyness+1+1)*bs samples at version 0: gate closes at
+            # expected_version > 1 + 0 → after 4+ accepted with bs=2
+            assert allowed >= 4
+        await mgr.stop()
+        await server.stop()
+
+    asyncio.run(main())

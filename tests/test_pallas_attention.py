@@ -22,7 +22,11 @@ def _packed_case(seqlens, Hq=4, Hkv=2, D=128, row_len=None, seed=0):
     return layout, grid, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
 
 
-@pytest.mark.parametrize("seqlens", [[128], [60, 68], [100, 20, 120, 9]])
+@pytest.mark.parametrize(
+    "seqlens",
+    [[128], [60, 68], [100, 20, 120, 9],
+     [300, 340]],  # T=640: 128-aligned but NOT a multiple of 512
+)
 @pytest.mark.parametrize("D", [64, 128])
 def test_flash_matches_reference(seqlens, D):
     from areal_tpu.ops.pallas.flash_attention import flash_attention
