@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import decode_attention, packed_attention
-from areal_tpu.parallel.sharding import constrain
+from areal_tpu.parallel.sharding import constrain, current_mesh
 
 Params = Dict[str, Any]
 
@@ -146,11 +146,30 @@ def _block(
     k = apply_rope(k, cos, sin)
 
     if cache_kv is None:
-        attn = packed_attention(
-            q, k, v, segment_ids, segment_ids,
-            q_positions=positions, kv_positions=positions,
-            causal=True, sliding_window=cfg.sliding_window, impl=attn_impl,
+        mesh = current_mesh()
+        # Ring attention needs shard_map-divisible shapes; shapes that don't
+        # divide (e.g. generate()'s unbucketed batch dim) keep the tolerant
+        # GSPMD path.
+        use_ring = (
+            mesh is not None
+            and mesh.shape.get("sp", 1) > 1
+            and cfg.sliding_window is None
+            and B % (mesh.shape["dp"] * mesh.shape["fsdp"]) == 0
+            and T % mesh.shape["sp"] == 0
+            and cfg.n_q_heads % mesh.shape["tp"] == 0
+            and cfg.n_kv_heads % mesh.shape["tp"] == 0
         )
+        if use_ring:
+            # Sequence dim sharded → context-parallel ring attention.
+            from areal_tpu.parallel.ring import ring_attention
+
+            attn = ring_attention(q, k, v, segment_ids, mesh)
+        else:
+            attn = packed_attention(
+                q, k, v, segment_ids, segment_ids,
+                q_positions=positions, kv_positions=positions,
+                causal=True, sliding_window=cfg.sliding_window, impl=attn_impl,
+            )
         new_kv = (k, v)
     else:
         k_cache, v_cache = cache_kv

@@ -131,6 +131,11 @@ def activation_sharding(mesh: Mesh, rules: Optional[Dict[str, P]] = None):
         _ACTIVE.pop()
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The mesh of the innermost activation_sharding context (or None)."""
+    return _ACTIVE[-1][0] if _ACTIVE else None
+
+
 def constrain(x: jnp.ndarray, kind: str) -> jnp.ndarray:
     if not _ACTIVE:
         return x

@@ -1,0 +1,86 @@
+"""Ring attention (context parallelism) parity + integration tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.models import packing, transformer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.ops import attention as attn
+from areal_tpu.parallel import mesh as pmesh
+from areal_tpu.parallel import sharding as psh
+from areal_tpu.parallel.ring import ring_attention
+
+
+def _case(seqlens, Hq, Hkv, D, row_len, seed=0):
+    rng = np.random.RandomState(seed)
+    # min 2 rows so the batch dim divides the dp×fsdp mesh axes
+    layout = packing.plan_packing(seqlens, row_len=row_len, min_rows=2)
+    grid = packing.make_grid(layout)
+    B, L = layout.shape
+    q = jnp.asarray(rng.randn(B, L, Hq, D).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(B, L, Hkv, D).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(B, L, Hkv, D).astype(np.float32) * 0.3)
+    return grid, q, k, v
+
+
+@pytest.mark.parametrize("spec", ["s4", "d2s2t2", "s8"])
+@pytest.mark.parametrize("seqlens,row_len", [([32], 32), ([20, 9, 3], 32)])
+def test_ring_matches_reference(spec, seqlens, row_len):
+    mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse(spec))
+    grid, q, k, v = _case(seqlens, Hq=4, Hkv=2, D=16, row_len=row_len)
+    seg = jnp.asarray(grid["segment_ids"])
+    pos = jnp.asarray(grid["positions"])
+    ref = attn.packed_attention(q, k, v, seg, seg, q_positions=pos,
+                                kv_positions=pos, impl="reference")
+    out = jax.jit(
+        lambda q, k, v, s: ring_attention(q, k, v, s, mesh)
+    )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gradients_flow():
+    mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse("s4"))
+    grid, q, k, v = _case([16, 12], Hq=2, Hkv=2, D=8, row_len=32)
+    seg = jnp.asarray(grid["segment_ids"])
+    pos = jnp.asarray(grid["positions"])
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seg, mesh) ** 2)
+
+    def loss_ref(q, k, v):
+        o = attn.packed_attention(q, k, v, seg, seg, q_positions=pos,
+                                  kv_positions=pos, impl="reference")
+        return jnp.sum(o**2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   err_msg=f"grad {name}")
+
+
+def test_transformer_forward_with_sp_mesh():
+    """Full model forward under an sp>1 mesh dispatches to ring attention
+    and matches the unsharded result."""
+    cfg = tiny_config(n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    B, T = 2, 32
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    positions = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    seg = np.ones((B, T), np.int32)
+    ref, _ = transformer.forward(params, cfg, tokens, positions,
+                                 segment_ids=seg)
+
+    mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2s2t2"))
+    sp = psh.shard_params(params, mesh, cfg)
+
+    def fwd(p, t, pos, s):
+        with psh.activation_sharding(mesh):
+            out, _ = transformer.forward(p, cfg, t, pos, segment_ids=s)
+        return out
+
+    out = jax.jit(fwd)(sp, tokens, positions, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
