@@ -173,17 +173,27 @@ def compute_advantages_and_returns(
     )
     rewards = kl_rw.copy()
     rewards[mb.seq_rows[:n], mb.seq_last_cols[:n]] += tok_score
-    # Truncated sequences bootstrap GAE with V(s_T) at their last token
-    # (cugae "truncate" semantics; reference pygae1d bootstrap mask).
+    # Reference value alignment (pygae1d_nolp_misalign; ppo_interface.py:
+    # 575-579): the baseline for the action at slot t is V at slot t−1 (the
+    # pre-action state), so δ_t = r_t + γ·V_t − V_{t−1}. In the grid layout
+    # that is gae_grid over right-shifted values, whose internal v_next[t]
+    # = v_shifted[t+1] = V_t.
+    v_prev = np.asarray(F.shift_right_in_doc(values, g["segment_ids"]))
+    # The last action's next-value is V at the final token, kept only when
+    # generation was truncated (no EOS): the reference both zeroes the EOS
+    # value and multiplies by the bootstrap mask — one product covers both.
     boot = np.zeros_like(values)
     boot[mb.seq_rows[:n], mb.seq_last_cols[:n]] = (
         values[mb.seq_rows[:n], mb.seq_last_cols[:n]] * no_eos
     )
     # GAE over action tokens only: restrict the segment grid to them so
-    # prompt positions neither receive advantage nor relay the recursion.
+    # prompt positions neither receive advantage nor relay the recursion
+    # (action slots are a contiguous suffix of each doc, so restricting
+    # changes nothing the actor loss reads). v_prev at the first action slot
+    # still holds the last-prompt-slot value — shift BEFORE restricting.
     act_seg = np.where(amask, g["segment_ids"], 0)
     adv, ret = F.gae_grid(
-        jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(act_seg),
+        jnp.asarray(rewards), jnp.asarray(v_prev), jnp.asarray(act_seg),
         bootstrap=jnp.asarray(boot),
         gamma=hp.discount, lam=hp.gae_lambda,
     )
@@ -419,9 +429,14 @@ class PPOCriticInterface(ModelInterface):
             amask = F.action_token_mask(
                 batch["segment_ids"], batch["prompt_mask"]
             )
+            # Returns at action slot t target the PRE-action value V_{t−1}
+            # (reference leave_one_indices pairing, ppo_interface.py:936-948):
+            # shift both the fresh forward values and the stored clip
+            # baseline right by one inside each doc before the loss.
+            seg = batch["segment_ids"]
             loss, st = F.critic_loss(
-                values,
-                batch["values"],
+                F.shift_right_in_doc(values, seg),
+                F.shift_right_in_doc(batch["values"], seg),
                 batch["_norm_returns"],
                 amask,
                 value_eps_clip=hp_.value_eps_clip,

@@ -66,6 +66,22 @@ def action_token_mask(segment_ids, prompt_mask):
     return (segment_ids > 0) & (prev_seg == segment_ids) & (prompt_mask == 0)
 
 
+def shift_right_in_doc(x, segment_ids):
+    """[B, L] → [B, L] with x shifted right by one inside each document:
+    out[t] = x[t−1] when t−1 is in the same doc, else 0.
+
+    Used to express the reference's value alignment (pygae1d_nolp_misalign,
+    ppo_interface.py:575-579) in the full-length grid layout: the PPO
+    baseline for the action at slot t is the critic value at slot t−1 (the
+    state BEFORE the token was emitted). Accepts numpy or jax arrays."""
+    xp = jnp if isinstance(x, jnp.ndarray) else np
+    prev = xp.concatenate([xp.zeros_like(x[:, :1]), x[:, :-1]], axis=1)
+    prev_seg = xp.concatenate(
+        [xp.zeros_like(segment_ids[:, :1]), segment_ids[:, :-1]], axis=1
+    )
+    return prev * ((prev_seg == segment_ids) & (segment_ids > 0))
+
+
 def masked_normalization(
     x: jnp.ndarray,
     mask: jnp.ndarray,

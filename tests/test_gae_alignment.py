@@ -1,0 +1,153 @@
+"""GAE value-alignment parity vs the REFERENCE convention.
+
+An independent numpy implementation of the reference's GAE pairing
+(``pygae1d_nolp_misalign``, ``realhf/impl/model/utils/ppo_functional.py:292``
+with the value/reward setup of ``ppo_interface.py:555-640``): for a sequence
+of L tokens with P prompt tokens,
+
+ - per-step rewards live on "short1" slots t ∈ [0, L−1), where slot t is the
+   reward for emitting token t+1 (KL penalty on action slots, task score on
+   the last slot),
+ - values are full-length (one per token, conditioning on that token), with
+   the EOS value zeroed for terminated sequences,
+ - δ_t = r_t + γ·V[t+1]·(boot if t last) − V[t],  adv_t = δ_t + γλ·adv_{t+1},
+ - the advantage at short1 slot t pairs with the action logprob of token
+   t+1; returns_t = adv_t + V[t] targets the PRE-action value.
+
+This must equal ``compute_advantages_and_returns`` (full-length layout:
+advantage for token t stored at slot t) — the round-1 bug paired r_t with
+V[t] instead of V[t−1], which this test is designed to catch.
+"""
+
+import numpy as np
+
+from areal_tpu.algorithms.ppo import (
+    PPOHyperparameters,
+    compute_advantages_and_returns,
+)
+from areal_tpu.api.data import SequenceSample
+
+
+def reference_gae_full_layout(
+    seqlens, prompt_lens, behav_lp, ref_lp, values, scores, no_eos,
+    kl_coef, gamma, lam,
+):
+    """Returns (adv, ret) as full-length packed arrays (slot t = token t;
+    zeros on prompt slots), computed with the reference convention."""
+    adv_out = np.zeros(sum(seqlens), np.float64)
+    ret_out = np.zeros(sum(seqlens), np.float64)
+    off = 0
+    for i, (L, P) in enumerate(zip(seqlens, prompt_lens)):
+        v = values[off : off + L].astype(np.float64).copy()
+        if not no_eos[i]:
+            v[L - 1] = 0.0  # zero the EOS-token value when terminated
+        # short1 rewards: KL on action slots, task score on the last slot.
+        r = np.zeros(L - 1, np.float64)
+        for t in range(L - 1):
+            tok = t + 1  # token emitted by action at short1 slot t
+            if tok >= P:  # action token → KL penalty applies
+                r[t] = -kl_coef * (behav_lp[off + tok] - ref_lp[off + tok])
+        r[L - 2] += scores[i]
+        adv = np.zeros(L - 1, np.float64)
+        lastgaelam = 0.0
+        for t in reversed(range(L - 1)):
+            nxt = v[t + 1]
+            if t == L - 2 and not no_eos[i]:
+                nxt = 0.0  # terminated: no bootstrap beyond EOS
+            delta = r[t] + gamma * nxt - v[t]
+            lastgaelam = delta + gamma * lam * lastgaelam
+            adv[t] = lastgaelam
+        # map short1 slot t → full slot t+1 (the token the action emitted)
+        for t in range(L - 1):
+            if t + 1 >= P:
+                adv_out[off + t + 1] = adv[t]
+                ret_out[off + t + 1] = adv[t] + v[t]
+        off += L
+    return adv_out, ret_out
+
+
+def _build_sample(rng, n_seq=6):
+    plens = rng.randint(2, 5, n_seq)
+    glens = rng.randint(3, 9, n_seq)
+    seqlens = (plens + glens).astype(int)
+    total = int(seqlens.sum())
+    pmask, behav, ref = [], [], []
+    for p, g in zip(plens, glens):
+        pmask.append(np.concatenate([np.ones(p, np.int32), np.zeros(g, np.int32)]))
+        lp = np.zeros(p + g, np.float32)
+        lp[p:] = -rng.rand(g)  # behaviour logprobs on action slots
+        behav.append(lp)
+        rlp = np.zeros(p + g, np.float32)
+        rlp[p:] = -rng.rand(g)
+        ref.append(rlp)
+    pmask = np.concatenate(pmask)
+    behav = np.concatenate(behav).astype(np.float32)
+    ref = np.concatenate(ref).astype(np.float32)
+    values = rng.randn(total).astype(np.float32)
+    scores = rng.randn(n_seq).astype(np.float32)
+    no_eos = rng.randint(0, 2, n_seq).astype(np.float32)
+    sample = SequenceSample.from_default(
+        ids=[f"s{i}" for i in range(n_seq)],
+        data={
+            "packed_input_ids": rng.randint(2, 100, total).astype(np.int32),
+            "prompt_mask": pmask,
+            "packed_logprobs": behav,
+            "packed_ref_logprobs": ref,
+            "values": values,
+            "rewards": scores,
+            "seq_no_eos_mask": no_eos,
+        },
+        seqlens=seqlens.tolist(),
+    )
+    return sample, seqlens, plens, behav, ref, values, scores, no_eos
+
+
+def test_gae_matches_reference_value_alignment():
+    rng = np.random.RandomState(3)
+    sample, seqlens, plens, behav, ref, values, scores, no_eos = _build_sample(rng)
+    kl_coef, gamma, lam = 0.2, 0.97, 0.93
+    hp = PPOHyperparameters(
+        discount=gamma, gae_lambda=lam, reward_output_scaling=1.0,
+        max_reward_clip=100.0,
+    )
+    out = compute_advantages_and_returns(sample, hp, kl_coef)
+    adv_ref, ret_ref = reference_gae_full_layout(
+        seqlens, plens, behav, ref, values, scores,
+        no_eos=(no_eos > 0), kl_coef=kl_coef, gamma=gamma, lam=lam,
+    )
+    np.testing.assert_allclose(out["advantages"], adv_ref, atol=2e-4)
+    np.testing.assert_allclose(out["returns"], ret_ref, atol=2e-4)
+
+
+def test_gae_action_dependent_baseline_is_gone():
+    """With γ=λ=1, no KL and zero score, the advantage at the FIRST action
+    slot must be −V[P−1] (pre-action baseline), not −V[P]."""
+    rng = np.random.RandomState(0)
+    P, G = 3, 4
+    L = P + G
+    values = rng.randn(L).astype(np.float32)
+    sample = SequenceSample.from_default(
+        ids=["a"],
+        data={
+            "packed_input_ids": rng.randint(2, 50, L).astype(np.int32),
+            "prompt_mask": np.concatenate(
+                [np.ones(P, np.int32), np.zeros(G, np.int32)]
+            ),
+            "packed_logprobs": np.zeros(L, np.float32),
+            "packed_ref_logprobs": np.zeros(L, np.float32),
+            "values": values,
+            "rewards": np.zeros(1, np.float32),
+            "seq_no_eos_mask": np.zeros(1, np.float32),  # terminated
+        },
+        seqlens=[L],
+    )
+    hp = PPOHyperparameters(discount=1.0, gae_lambda=1.0)
+    out = compute_advantages_and_returns(sample, hp, kl_coef=0.0)
+    # telescoping: adv at first action slot = sum(deltas) = −V[P−1]
+    np.testing.assert_allclose(out["advantages"][P], -values[P - 1], atol=1e-5)
+    # and the return target for the first action is V[P−1] + adv = 0 here;
+    # more usefully: ret[t] − adv[t] must equal V[t−1] on every action slot.
+    for t in range(P, L):
+        np.testing.assert_allclose(
+            out["returns"][t] - out["advantages"][t], values[t - 1], atol=1e-5
+        )
