@@ -37,6 +37,10 @@ class AsyncSequenceBuffer:
         self._slots: Dict[Hashable, _Slot] = {}
         self._lock = asyncio.Lock()
         self._changed = asyncio.Condition(self._lock)
+        # ids whose slots were fully consumed/dropped since the last
+        # pop_freed() — the master forwards these to the trainer's "clear"
+        # handler so its tensor store can GC (it otherwise grows unbounded).
+        self._freed: List[Hashable] = []
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -100,6 +104,7 @@ class AsyncSequenceBuffer:
                         out.append(slot.sample.meta())
                         if slot.reads_left <= 0:
                             del self._slots[sid]
+                            self._freed.append(sid)
                     return out
                 wait = None
                 if deadline is not None:
@@ -128,10 +133,18 @@ class AsyncSequenceBuffer:
                 slot.reads_left -= 1
                 if slot.reads_left <= 0:
                     del self._slots[sid]
+                    self._freed.append(sid)
             self._changed.notify_all()
 
     async def drop_ids(self, ids: Sequence[Hashable]) -> None:
         async with self._lock:
             for sid in ids:
-                self._slots.pop(sid, None)
+                if self._slots.pop(sid, None) is not None:
+                    self._freed.append(sid)
             self._changed.notify_all()
+
+    async def pop_freed(self) -> List[Hashable]:
+        """Fully-consumed sample ids since the last call (for trainer GC)."""
+        async with self._lock:
+            out, self._freed = self._freed, []
+            return out

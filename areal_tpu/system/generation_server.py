@@ -93,21 +93,29 @@ class GenerationServer:
         import jax
 
         cfg = self.cfg
+        # Capture (params, version) atomically: handle_update_weights swaps
+        # both on the event loop while we run in a thread, and tokens
+        # sampled under the old weights must be tagged with the version
+        # that actually produced them (decoupled-loss bookkeeping).
+        params, version = self.params, self.version
         chunk = min(cfg.chunk_tokens, max(p.max_tokens for p in batch))
         prompts = [p.prompt for p in batch]
         padded, plens = genmod.pad_prompts(
             prompts, cfg.pad_token_id, bucket=cfg.prompt_bucket
         )
         self._key, sub = jax.random.split(self._key)
-        gconfig = batch[0].gconfig  # sampling params are per-batch v1
+        # _runner groups the batch by identical sampling params.
+        gconfig = batch[0].gconfig
         out = genmod.generate_batch(
-            self.params, self.model_cfg, padded, plens, sub,
+            params, self.model_cfg, padded, plens, sub,
             gconfig, max_new_tokens=chunk,
             eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
         )
         res = []
         for i, p in enumerate(batch):
-            n = int(out["output_lens"][i])
+            # Never hand back more than the request's remaining budget —
+            # the client appends every token we return.
+            n = min(int(out["output_lens"][i]), p.max_tokens)
             toks = np.asarray(out["output_ids"][i][:n])
             lps = np.asarray(out["output_logprobs"][i][:n])
             # "finished" = the MODEL ended the sequence (EOS). Budget
@@ -118,7 +126,7 @@ class GenerationServer:
                 "output_ids": toks.tolist(),
                 "output_logprobs": lps.tolist(),
                 "finished": emitted_eos,
-                "version": self.version,
+                "version": version,
             })
             self._tokens_out += n
         return res
@@ -129,8 +137,19 @@ class GenerationServer:
             first: _Pending = await self._queue.get()
             batch = [first]
             await asyncio.sleep(cfg.batch_window_ms / 1000)
+            # Drain only requests with the SAME sampling params as the
+            # head of the batch — one generate_batch call applies one
+            # gconfig, and mixed-temperature clients must not silently get
+            # the first request's params. Mismatches go back in the queue.
+            deferred = []
             while len(batch) < cfg.max_batch_size and not self._queue.empty():
-                batch.append(self._queue.get_nowait())
+                p = self._queue.get_nowait()
+                if p.gconfig == first.gconfig:
+                    batch.append(p)
+                else:
+                    deferred.append(p)
+            for p in deferred:
+                self._queue.put_nowait(p)
             try:
                 results = await asyncio.to_thread(self._decode_batch, batch)
                 for p, r in zip(batch, results):

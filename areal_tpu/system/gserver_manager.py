@@ -40,6 +40,9 @@ class GserverManagerConfig:
     weight_poll_secs: float = 1.0
     port: Optional[int] = None
     keep_last_versions: int = 2
+    # Routing leases expire if the client neither renews (per chunk) nor
+    # releases — a crashed client must not pin inflight counts forever.
+    lease_ttl_secs: float = 120.0
 
 
 class GserverManager:
@@ -49,6 +52,11 @@ class GserverManager:
         self.version = 0
         self._rr = 0
         self._inflight: Dict[str, int] = {}  # url -> outstanding requests
+        self._leases: Dict[str, tuple] = {}  # lease_id -> (url, expires_at)
+        self._lease_seq = 0
+        # Both staleness terms are counted in SAMPLE units (the reference's
+        # is_staled compares against train_batch_size samples): a rollout
+        # allocation of group_size samples adds group_size to running.
         self.running_rollouts = 0
         self.accepted_rollouts = 0  # trained samples submitted
         self._watcher_task = None
@@ -70,7 +78,17 @@ class GserverManager:
 
     # ---------------- scheduling ----------------
 
+    def _expire_leases(self) -> None:
+        now = time.monotonic()
+        dead = [lid for lid, (_, exp) in self._leases.items() if exp < now]
+        for lid in dead:
+            url, _ = self._leases.pop(lid)
+            if self._inflight.get(url, 0) > 0:
+                self._inflight[url] -= 1
+            logger.warning(f"lease {lid} on {url} expired (client gone?)")
+
     def _pick_server(self) -> str:
+        self._expire_leases()
         if self.cfg.schedule_policy == "least_requests":
             return min(self.servers, key=lambda u: self._inflight[u])
         url = self.servers[self._rr % len(self.servers)]
@@ -90,12 +108,40 @@ class GserverManager:
 
         url = self._pick_server()
         self._inflight[url] += 1
-        return web.json_response({"url": url, "version": self.version})
+        self._lease_seq += 1
+        lease_id = f"l{self._lease_seq}"
+        self._leases[lease_id] = (
+            url, time.monotonic() + self.cfg.lease_ttl_secs
+        )
+        return web.json_response({
+            "url": url, "version": self.version, "lease_id": lease_id,
+        })
+
+    async def handle_renew(self, request):
+        from aiohttp import web
+
+        d = await request.json()
+        lid = d.get("lease_id")
+        if lid in self._leases:
+            url, _ = self._leases[lid]
+            self._leases[lid] = (
+                url, time.monotonic() + self.cfg.lease_ttl_secs
+            )
+            return web.json_response({"ok": True})
+        return web.json_response({"ok": False, "reason": "unknown lease"})
 
     async def handle_release(self, request):
         from aiohttp import web
 
         d = await request.json()
+        lid = d.get("lease_id")
+        if lid is not None:
+            if lid in self._leases:
+                u, _ = self._leases.pop(lid)
+                if self._inflight.get(u, 0) > 0:
+                    self._inflight[u] -= 1
+            return web.json_response({"ok": True})
+        # legacy: release by url (no lease bookkeeping)
         u = d.get("url")
         if u in self._inflight and self._inflight[u] > 0:
             self._inflight[u] -= 1
@@ -104,20 +150,23 @@ class GserverManager:
     async def handle_allocate_rollout(self, request):
         from aiohttp import web
 
+        d = await request.json()
+        n = int(d.get("n_samples", 1))
         if self.running_rollouts >= self.cfg.max_concurrent_rollouts:
             return web.json_response({"allowed": False, "reason": "capacity"})
         if self.is_staled():
             return web.json_response({"allowed": False, "reason": "staleness"})
-        self.running_rollouts += 1
+        self.running_rollouts += n
         return web.json_response({"allowed": True, "version": self.version})
 
     async def handle_finish_rollout(self, request):
         from aiohttp import web
 
         d = await request.json()
-        self.running_rollouts = max(0, self.running_rollouts - 1)
+        n = int(d.get("n_samples", 1))
+        self.running_rollouts = max(0, self.running_rollouts - n)
         if d.get("accepted"):
-            self.accepted_rollouts += int(d.get("n_samples", 1))
+            self.accepted_rollouts += n
         return web.json_response({"ok": True})
 
     async def handle_get_model_version(self, request):
@@ -176,6 +225,7 @@ class GserverManager:
 
         app = web.Application()
         app.router.add_post("/schedule_request", self.handle_schedule_request)
+        app.router.add_post("/renew", self.handle_renew)
         app.router.add_post("/release", self.handle_release)
         app.router.add_post("/allocate_rollout", self.handle_allocate_rollout)
         app.router.add_post("/finish_rollout", self.handle_finish_rollout)

@@ -203,9 +203,11 @@ class MasterWorker:
             )
             if self._save_ctl.check(epochs=0, steps=1):
                 await asyncio.to_thread(self._request_save)
-            # post-step GC of consumed data on the trainer
+            # post-step GC: tell the trainer which samples were fully
+            # consumed so its tensor store can drop them.
+            freed = await self.buffer.pop_freed()
             await asyncio.to_thread(
-                self.stream.call, self.cfg.trainer_handler, "clear", []
+                self.stream.call, self.cfg.trainer_handler, "clear", freed
             )
         total = time.monotonic() - t_start
         logger.info(f"experiment complete: {self.step} steps in {total:.1f}s")
