@@ -341,20 +341,28 @@ class PPOActorInterface(ModelInterface):
         for mb_sample in minibatches:
             if mb_sample.bs == 0:
                 continue
+            # Early-stop semantics (reference ppo_interface.py:735-760): the
+            # importance ratio is checked BEFORE the optimizer step — the
+            # engine skips the update on device when the ratio exceeds the
+            # cap, and we stop the remaining minibatches.
             stats = engine.train_batch(
                 mb_sample, mb_spec, self._loss_fn,
                 _action_token_weight,
                 version_steps=model.version.global_step,
+                skip_update_rule=(
+                    "importance_weight_sum", "n_action_tokens",
+                    hp.early_stop_imp_ratio or 0.0,
+                ),
             )
             n_steps += 1
-            n = max(stats.get("n_action_tokens", 1.0), 1.0)
-            imp = stats.get("importance_weight_sum", 0.0) / n
             for k, v in stats.items():
                 agg[k] = agg.get(k, 0.0) + float(v)
-            if hp.early_stop_imp_ratio and imp > hp.early_stop_imp_ratio:
+            if stats.get("update_applied", 1.0) == 0.0:
+                n = max(stats.get("n_action_tokens", 1.0), 1.0)
+                imp = stats.get("importance_weight_sum", 0.0) / n
                 logger.warning(
                     f"early-stopping PPO minibatches: importance ratio "
-                    f"{imp:.2f} > {hp.early_stop_imp_ratio}"
+                    f"{imp:.2f} > {hp.early_stop_imp_ratio} (update skipped)"
                 )
                 break
         self.kl_ctl.update(mean_kl, n_steps=1)

@@ -1,0 +1,402 @@
+"""Experiment configuration tree + CLI/YAML merge.
+
+Parity target: ``realhf/api/cli_args.py`` (1558 LoC) — the single-file
+dataclass config tree that hydra merges YAML and dotted CLI overrides onto.
+We have no hydra in the TPU image, so this module also implements the merge
+itself: :func:`apply_overrides` walks dotted ``a.b.c=value`` assignments
+onto a (nested) dataclass instance with field-type coercion and typo-safe
+errors, and :func:`load_yaml`/:func:`to_yaml_dict` round-trip configs the
+way the reference dumps ``config.yaml`` next to each run
+(``training/main_async_ppo.py:40-50``).
+
+Field names deliberately mirror the reference so launch commands like
+``examples/run_async_ppo.sh`` port verbatim (that IS the compatibility
+contract): ``allocation_mode=...``, ``actor.type._class=qwen3``,
+``dataset.train_bs_n_seqs=32``, ``ppo.gen.max_new_tokens=4096``,
+``actor_train.mb_spec.max_tokens_per_mb=32768``,
+``max_head_offpolicyness=4`` …
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import difflib
+import typing
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.data import MicroBatchSpec
+from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
+
+# Re-exported so experiment configs can be built from this one module, the
+# way everything in the reference imports from realhf.api.cli_args.
+from areal_tpu.backend.jax_train import OptimizerConfig  # noqa: F401
+from areal_tpu.system.master_worker import ExperimentSaveEvalControl  # noqa: F401
+
+
+# --------------------------------------------------------------------------
+# leaf config groups
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModelFamily:
+    """Reference cli_args.py:99. ``_class`` picks the HF family converter
+    (llama/qwen2/qwen3/...), or "tiny" for fabricated test models."""
+
+    _class: str = "qwen3"
+    size: int = 0
+    is_critic: bool = False
+
+
+@dataclasses.dataclass
+class ModelTrainEvalConfig:
+    """One model role (reference cli_args.py:433).
+
+    TPU notes: ``backend`` is the jax train/inference engine for every
+    trainable role; Megatron-only knobs (ddp, overlap_grad_reduce, ...)
+    have no analogue under GSPMD and are intentionally absent.
+    """
+
+    type: ModelFamily = dataclasses.field(default_factory=ModelFamily)
+    path: str = ""  # HF checkpoint dir (or empty with init_from_scratch)
+    init_from_scratch: bool = False
+    gradient_checkpointing: bool = True
+    bf16: bool = True
+    optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=OptimizerConfig
+    )
+    backend: str = "jax_train"
+    # Fabricated tiny model for CPU tests (reference base/testing.py models):
+    # e.g. actor.tiny.vocab_size=258. Empty = use `path`.
+    tiny: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class MFCConfig:
+    """Per-MFC runtime knobs (reference cli_args.py:496)."""
+
+    mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
+
+
+@dataclasses.dataclass
+class PromptOnlyDatasetConfig:
+    """Reference cli_args.py:44 (PromptOnlyDatasetConfig)."""
+
+    path: str = ""
+    max_prompt_len: int = 1024
+    train_bs_n_seqs: int = 256
+    fill_to_max_length: bool = False
+
+
+@dataclasses.dataclass
+class PromptAnswerDatasetConfig:
+    """SFT dataset (reference cli_args.py:58)."""
+
+    path: str = ""
+    max_seqlen: int = 1024
+    train_bs_n_seqs: int = 256
+    valid_bs_n_seqs: int = 256
+    fill_to_max_length: bool = False
+
+
+from areal_tpu.base.name_resolve import NameResolveConfig  # noqa: F401,E402
+
+
+@dataclasses.dataclass
+class ClusterSpecConfig:
+    """Reference cli_args.py:896."""
+
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    n_nodes: int = 1
+    n_gpus_per_node: int = 8  # chips per host on TPU; name kept for parity
+    name_resolve: NameResolveConfig = dataclasses.field(
+        default_factory=NameResolveConfig
+    )
+
+
+@dataclasses.dataclass
+class WandBConfig:
+    """Reference cli_args.py:837 (subset; offline by default on TPU pods)."""
+
+    mode: str = "disabled"
+    entity: Optional[str] = None
+    project: Optional[str] = None
+    name: Optional[str] = None
+
+
+@dataclasses.dataclass
+class TensorBoardConfig:
+    """Reference cli_args.py:863."""
+
+    path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class AutomaticEvaluatorConfig:
+    """Reference cli_args.py:791 (AutomaticEvaluator)."""
+
+    data_names: str = "aime24"
+    max_gen_tokens: int = 32768
+    max_concurrent_jobs: int = 1
+    eval_job_image: Optional[str] = None
+    initial_checkpoint_path: Optional[str] = None
+    prompt_type: str = "math-cot"
+
+
+# --------------------------------------------------------------------------
+# experiment root
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BaseExperimentConfig:
+    """Reference cli_args.py:944 (BaseExperimentConfig).
+
+    ``mode`` on TPU: "local" spawns every worker on this host (tests and
+    single-host runs); "ray"/"slurm" are reserved words kept for CLI parity
+    and raise until a cluster scheduler lands.
+    """
+
+    experiment_name: str = "areal-tpu"
+    trial_name: str = ""
+    mode: str = "local"
+    backend: str = "tpu"  # accepted for parity with `--backend=tpu`
+    debug: bool = True
+    partition: str = "dev"
+    schedule_strategy: str = "empty_first"
+    recover_mode: str = "disabled"  # disabled | auto | resume | fault
+    recover_retries: int = 1
+    ignore_worker_error: bool = False
+    allocation_mode: str = ""
+    n_nodes: int = 1
+    n_gpus_per_node: int = 8
+    seed: int = 1
+    cluster: ClusterSpecConfig = dataclasses.field(
+        default_factory=ClusterSpecConfig
+    )
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    wandb: WandBConfig = dataclasses.field(default_factory=WandBConfig)
+    tensorboard: TensorBoardConfig = dataclasses.field(
+        default_factory=TensorBoardConfig
+    )
+    auto_eval: bool = False
+    auto_eval_config: AutomaticEvaluatorConfig = dataclasses.field(
+        default_factory=AutomaticEvaluatorConfig
+    )
+    torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
+    cache_clear_freq: Optional[int] = 10
+    # Test-only: use the deterministic mock tokenizer instead of HF.
+    mock_tokenizer: bool = False
+
+    def resolve_trial_name(self) -> str:
+        if not self.trial_name:
+            import datetime
+
+            self.trial_name = (
+                "run" + datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+            )
+        return self.trial_name
+
+
+# --------------------------------------------------------------------------
+# YAML + dotted-override machinery (the hydra replacement)
+# --------------------------------------------------------------------------
+
+
+def _field_map(obj) -> Dict[str, dataclasses.Field]:
+    return {f.name: f for f in dataclasses.fields(obj)}
+
+
+_HINT_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _field_type(obj, name: str):
+    """Resolved (non-string) annotation for a field — modules using
+    ``from __future__ import annotations`` store them as strings."""
+    cls = type(obj)
+    if cls not in _HINT_CACHE:
+        try:
+            _HINT_CACHE[cls] = typing.get_type_hints(cls)
+        except Exception:  # unresolvable forward refs: fall back per-field
+            _HINT_CACHE[cls] = {}
+    return _HINT_CACHE[cls].get(name, _field_map(obj)[name].type)
+
+
+def _strip_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _coerce(value: str, tp) -> Any:
+    """Parse a CLI string into the annotated field type."""
+    tp = _strip_optional(tp)
+    if value.lower() in ("null", "none"):
+        return None
+    if tp is bool or tp == "bool":
+        if value.lower() in ("1", "true", "yes", "on"):
+            return True
+        if value.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"cannot parse bool from {value!r}")
+    if tp is int or tp == "int":
+        return int(value)
+    if tp is float or tp == "float":
+        return float(value)
+    if tp is str or tp == "str":
+        return value
+    origin = typing.get_origin(tp)
+    if origin in (list, List):
+        (etp,) = typing.get_args(tp) or (str,)
+        if not value:
+            return []
+        return [_coerce(v.strip(), etp) for v in value.split(",")]
+    if origin in (dict, Dict):
+        import json
+
+        return json.loads(value)
+    if tp is Any:
+        import json
+
+        try:
+            return json.loads(value)
+        except (ValueError, TypeError):
+            return value
+    raise ValueError(f"don't know how to parse {value!r} as {tp}")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _safe_set(obj, key: str, val):
+    """setattr that tolerates frozen dataclasses; returns the (possibly
+    new) object holding the assignment."""
+    try:
+        setattr(obj, key, val)
+        return obj
+    except dataclasses.FrozenInstanceError:
+        return dataclasses.replace(obj, **{key: val})
+
+
+def _assign(obj, parts: List[str], value: str, path: str):
+    fm = _field_map(obj)
+    key = parts[0]
+    if key not in fm:
+        raise ConfigError(_unknown_key_msg(obj, key, path))
+    if len(parts) == 1:
+        return _safe_set(obj, key, _coerce(value, _field_type(obj, key)))
+    child = getattr(obj, key)
+    if dataclasses.is_dataclass(child):
+        return _safe_set(obj, key, _assign(child, parts[1:], value, path))
+    if isinstance(child, dict):
+        # dict leaf: remaining path becomes a (typed-by-json) dict key
+        child[".".join(parts[1:])] = _coerce(value, Any)
+        return obj
+    raise ConfigError(f"'{key}' is a leaf; cannot descend into '{path}'")
+
+
+def _set_dotted(obj, path: str, value: str) -> None:
+    if _assign(obj, path.split("."), value, path) is not obj:
+        raise ConfigError(
+            f"top-level config {type(obj).__name__} must not be frozen"
+        )
+
+
+def _unknown_key_msg(obj, key: str, path: str) -> str:
+    names = [f.name for f in dataclasses.fields(obj)]
+    close = difflib.get_close_matches(key, names, n=3)
+    hint = f" (did you mean: {', '.join(close)}?)" if close else ""
+    return (
+        f"unknown config key '{path}' on {type(obj).__name__}{hint}; "
+        f"valid keys: {', '.join(sorted(names))}"
+    )
+
+
+def apply_overrides(cfg, overrides: List[str]):
+    """Apply ``a.b.c=value`` assignments in order. Mutates and returns cfg."""
+    for ov in overrides:
+        if "=" not in ov:
+            raise ConfigError(f"override {ov!r} is not of the form key=value")
+        key, _, value = ov.partition("=")
+        _set_dotted(cfg, key.strip(), value.strip())
+    return cfg
+
+
+def merge_dict(cfg, d: Dict[str, Any], _path: str = ""):
+    """Merge a (nested) plain dict — e.g. parsed YAML — onto a dataclass."""
+    fm = _field_map(cfg)
+    for k, v in d.items():
+        path = f"{_path}.{k}" if _path else k
+        if k not in fm:
+            raise ConfigError(_unknown_key_msg(cfg, k, path))
+        cur = getattr(cfg, k)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            cfg = _safe_set(cfg, k, merge_dict(cur, v, path))
+        elif isinstance(v, str) and not isinstance(cur, str) \
+                and not dataclasses.is_dataclass(cur):
+            cfg = _safe_set(cfg, k, _coerce(v, _field_type(cfg, k)))
+        else:
+            cfg = _safe_set(cfg, k, v)
+    return cfg
+
+
+def load_yaml(cfg, path: str):
+    import yaml
+
+    with open(path) as f:
+        d = yaml.safe_load(f) or {}
+    return merge_dict(cfg, d)
+
+
+def to_yaml_dict(cfg) -> Dict[str, Any]:
+    """dataclass → plain dict safe for yaml.dump (reference dumps asdict)."""
+    out = dataclasses.asdict(cfg)
+
+    def clean(x):
+        if isinstance(x, dict):
+            return {k: clean(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [clean(v) for v in x]
+        if isinstance(x, (str, int, float, bool)) or x is None:
+            return x
+        return repr(x)
+
+    return clean(out)
+
+
+def save_yaml(cfg, path: str) -> None:
+    import os
+
+    import yaml
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.dump(to_yaml_dict(cfg), f, default_flow_style=False,
+                  sort_keys=False)
+
+
+def print_config_help(cfg, _indent: int = 0) -> None:
+    """Recursive ``--help`` printer (reference cli_args.py:1421)."""
+    pad = "  " * _indent
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(v):
+            print(f"{pad}{f.name}:  ({type(v).__name__})")
+            print_config_help(v, _indent + 1)
+        else:
+            print(f"{pad}{f.name} = {v!r}")
+
+
+def get_log_path(cfg: BaseExperimentConfig) -> str:
+    """<fileroot>/logs/<experiment>/<trial> (reference constants.get_log_path)."""
+    import os
+
+    return os.path.join(
+        cfg.cluster.fileroot, "logs", cfg.experiment_name, cfg.trial_name
+    )

@@ -1,0 +1,256 @@
+"""Local experiment launcher.
+
+Parity target: ``realhf/apps/main.py:80`` (main_start) +
+``realhf/scheduler/local/client.py:71`` (LocalSchedulerClient) +
+``training/utils.py:123`` (_run_experiment): spawn one process per worker,
+run the master loop in the launcher process, monitor children, tear down.
+
+TPU shape: the *trainer* is ONE process owning the whole trainer mesh
+(single-controller SPMD — the reference's per-GPU model workers collapse);
+the async generation fleet (servers + manager) is a second process group on
+its own slice; rollout workers are CPU asyncio processes. ``mode="local"``
+covers single-host; multi-host adds ``jax.distributed`` (launcher-side
+support lands with the multi-host runtime).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("apps.launcher")
+
+
+# ---------------------------------------------------------------------------
+# child-process entries (must be module-level for mp spawn pickling)
+# ---------------------------------------------------------------------------
+
+
+def _child_init(exp_cfg, force_cpu: bool) -> None:
+    if force_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from areal_tpu.experiments import common as C
+
+    C.setup_name_resolve(exp_cfg)
+    # Registration side effects for every factory the configs reference.
+    import areal_tpu.agents.math_single_step  # noqa: F401
+    import areal_tpu.algorithms.ppo  # noqa: F401
+    import areal_tpu.algorithms.reward  # noqa: F401
+    import areal_tpu.algorithms.sft  # noqa: F401
+    import areal_tpu.backend.jax_train  # noqa: F401
+    import areal_tpu.datasets.jsonl  # noqa: F401
+
+
+def _resolve_tokenizer(exp_cfg):
+    from areal_tpu.experiments import common as C
+
+    path = getattr(exp_cfg, "actor", None)
+    model_path = path.path if path is not None else getattr(
+        exp_cfg, "model", None
+    ).path
+    return C.make_tokenizer(exp_cfg, model_path)
+
+
+def trainer_entry(exp_cfg, trainer_cfg, force_cpu: bool) -> None:
+    _child_init(exp_cfg, force_cpu)
+    from areal_tpu.system.trainer_worker import TrainerWorker
+
+    trainer_cfg.tokenizer = _resolve_tokenizer(exp_cfg)
+    TrainerWorker(trainer_cfg).run()
+
+
+def gen_fleet_entry(exp_cfg, server_cfgs, manager_cfg, force_cpu: bool) -> None:
+    """All generation servers + the gserver manager in one asyncio loop."""
+    _child_init(exp_cfg, force_cpu)
+    import asyncio
+
+    import jax
+
+    from areal_tpu.experiments.common import model_init_dict
+    from areal_tpu.system.generation_server import GenerationServer
+    from areal_tpu.system.gserver_manager import GserverManager
+
+    init = model_init_dict(exp_cfg.actor)
+
+    def build_model():
+        if "tiny" in init:
+            from areal_tpu.models import transformer
+            from areal_tpu.models.config import tiny_config
+
+            kw = dict(init["tiny"])
+            seed = kw.pop("seed", 0)
+            cfg = tiny_config(**kw)
+            return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+        from areal_tpu.models import hf as hfmod
+
+        cfg, params, _ = hfmod.load_hf_model(init["hf_dir"])
+        return cfg, params
+
+    async def main():
+        cfg, params = build_model()
+        tok = _resolve_tokenizer(exp_cfg)
+        eos = getattr(tok, "eos_token_id", None)
+        servers = []
+        for sc in server_cfgs:
+            if eos is not None:
+                sc.eos_token_id = int(eos)
+            srv = GenerationServer(sc, cfg, params)
+            await srv.start()
+            servers.append(srv)
+        mgr = GserverManager(manager_cfg)
+        await mgr.start()
+        while True:  # runs until the launcher terminates us
+            await asyncio.sleep(3600)
+
+    asyncio.run(main())
+
+
+def rollout_entry(exp_cfg, rollout_cfg, force_cpu: bool) -> None:
+    _child_init(exp_cfg, force_cpu)
+    import asyncio
+
+    from areal_tpu.system.rollout_worker import RolloutWorker
+
+    rollout_cfg.tokenizer = _resolve_tokenizer(exp_cfg)
+    eos = getattr(rollout_cfg.tokenizer, "eos_token_id", None)
+    if eos is not None:
+        rollout_cfg.eos_token_id = int(eos)
+    asyncio.run(RolloutWorker(rollout_cfg).run_async())
+
+
+# ---------------------------------------------------------------------------
+# launcher
+# ---------------------------------------------------------------------------
+
+
+class LocalLauncher:
+    """Spawn workers, run the master inline, monitor, tear down."""
+
+    def __init__(self, exp_cfg, force_cpu: Optional[bool] = None):
+        self.exp_cfg = exp_cfg
+        # Tests force CPU everywhere; real runs use the native platform.
+        self.force_cpu = (
+            force_cpu if force_cpu is not None
+            else bool(getattr(exp_cfg, "mock_tokenizer", False))
+        )
+        self.procs: List[mp.process.BaseProcess] = []
+
+    def _spawn(self, target, *args, name: str) -> None:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=target, args=args, daemon=True, name=name)
+        p.start()
+        self.procs.append(p)
+        logger.info(f"spawned {name} (pid {p.pid})")
+
+    def _check_children(self) -> None:
+        for p in self.procs:
+            if not p.is_alive() and p.exitcode not in (0, None):
+                raise RuntimeError(
+                    f"worker {p.name} died with exit code {p.exitcode}"
+                )
+
+    def run(self) -> Dict[str, Any]:
+        from areal_tpu.experiments import common as C
+        from areal_tpu.system.master_worker import MasterWorker
+
+        exp = self.exp_cfg
+        exp.resolve_trial_name()
+        C.setup_name_resolve(exp)
+        setup = exp.initial_setup()
+
+        # Persist the merged config next to the run (reference main_*.py).
+        from areal_tpu.api import cli_args as CA
+
+        CA.save_yaml(exp, os.path.join(
+            CA.get_log_path(exp), "config.yaml"
+        ))
+
+        self._spawn(trainer_entry, exp, setup["trainer"], self.force_cpu,
+                    name="trainer")
+        if "gen_servers" in setup:
+            self._spawn(
+                gen_fleet_entry, exp, setup["gen_servers"],
+                setup["gserver_manager"], self.force_cpu, name="gen_fleet",
+            )
+            for i, rc in enumerate(setup["rollout_workers"]):
+                self._spawn(rollout_entry, exp, rc, self.force_cpu,
+                            name=f"rollout{i}")
+
+        master = MasterWorker(setup["master"], setup["dfg"])
+        try:
+            result = self._run_master_monitored(master)
+        finally:
+            self.shutdown()
+        return result
+
+    def _run_master_monitored(self, master) -> Dict[str, Any]:
+        import threading
+
+        result: Dict[str, Any] = {}
+        err: List[BaseException] = []
+
+        def run():
+            try:
+                result.update(master.run())
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        while t.is_alive():
+            self._check_children()
+            t.join(timeout=1.0)
+        if err:
+            raise err[0]
+        return result
+
+    def shutdown(self) -> None:
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self.procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                p.kill()
+
+
+def run_experiment(exp_cfg) -> Dict[str, Any]:
+    """Entry used by training/main_*.py (reference training/utils.py:339).
+
+    ``recover_mode`` ∈ {disabled, resume, auto, fault}: "resume" restores
+    from the latest recover checkpoint immediately; "auto"/"fault"
+    additionally re-launch the whole experiment (with recovery) when a
+    worker dies, up to ``recover_retries`` times — the reference's
+    launcher-level restart loop (``realhf/apps/main.py:118-180``).
+    """
+    mode = getattr(exp_cfg, "mode", "local")
+    if mode != "local":
+        raise NotImplementedError(
+            f"mode={mode!r}: only 'local' (single-host) is implemented; "
+            "multi-host launch lands with the jax.distributed runtime"
+        )
+    recover_mode = getattr(exp_cfg, "recover_mode", "disabled")
+    retries = (
+        getattr(exp_cfg, "recover_retries", 1)
+        if recover_mode in ("auto", "fault") else 0
+    )
+    attempt = 0
+    while True:
+        try:
+            return LocalLauncher(exp_cfg).run()
+        except Exception:
+            attempt += 1
+            if attempt > retries:
+                raise
+            logger.warning(
+                f"experiment failed (attempt {attempt}/{retries}); "
+                "re-launching with recovery"
+            )
+            exp_cfg.recover_mode = "resume"

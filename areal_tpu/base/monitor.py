@@ -1,0 +1,113 @@
+"""Analytic FLOPs / MFU accounting and experiment metric writers.
+
+Parity target: ``realhf/base/monitor.py:288-330`` (llama-family analytic
+FLOPs formulas feeding TFLOPs/GPU master logs) + the master's
+wandb/swanlab/tensorboard init (``realhf/system/master_worker.py:291-350``)
++ ``realhf/system/flops_counter.py`` (per-MFC FLOPs sums). TPU differences:
+peak-FLOPs table is per TPU generation (bf16), and the writers degrade
+gracefully to tensorboard-only (wandb is optional on pods).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheet numbers).
+TPU_PEAK_BF16 = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v4": 275e12, "v6e": 918e12, "v6": 918e12, "v5": 459e12,
+}
+
+
+def device_peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    if device_kind is None:
+        import jax
+
+        device_kind = str(jax.devices()[0])
+    kind = device_kind.lower()
+    return next((v for k, v in TPU_PEAK_BF16.items() if k in kind), None)
+
+
+def transformer_flops_per_token(
+    n_layers: int,
+    hidden_dim: int,
+    q_dim: int,
+    kv_dim: int,
+    intermediate_dim: int,
+    vocab_size: int,
+    avg_seqlen: float,
+    backward: bool = True,
+) -> float:
+    """Analytic FLOPs per token (llama formula family, reference
+    monitor.py:288-330): matmul terms 2·m·n·k plus the attention-score
+    quadratic term; backward ≈ 2× forward."""
+    d, f = hidden_dim, intermediate_dim
+    attn_proj = 2 * d * (q_dim + 2 * kv_dim) + 2 * q_dim * d
+    attn_score = 2 * 2 * q_dim * avg_seqlen  # QK^T and PV, causal avg ≈ L/2·2
+    mlp = 3 * 2 * d * f
+    per_layer = attn_proj + attn_score + mlp
+    head = 2 * d * vocab_size
+    fwd = n_layers * per_layer + head
+    return fwd * (3.0 if backward else 1.0)
+
+
+def model_flops_per_token(cfg, avg_seqlen: float, backward: bool = True) -> float:
+    """FLOPs/token from a models.config.TransformerConfig."""
+    return transformer_flops_per_token(
+        cfg.n_layers, cfg.hidden_dim, cfg.q_dim, cfg.kv_dim,
+        cfg.intermediate_dim, 1 if cfg.is_critic else cfg.vocab_size,
+        avg_seqlen, backward=backward,
+    )
+
+
+class FlopsCounter:
+    """Per-step FLOPs sum over MFCs (reference flops_counter.py:15)."""
+
+    def __init__(self):
+        self.flops = 0.0
+
+    def add_train(self, cfg, n_tokens: float, avg_seqlen: float) -> None:
+        self.flops += model_flops_per_token(cfg, avg_seqlen, True) * n_tokens
+
+    def add_inf(self, cfg, n_tokens: float, avg_seqlen: float) -> None:
+        self.flops += model_flops_per_token(cfg, avg_seqlen, False) * n_tokens
+
+    def pop(self) -> float:
+        f, self.flops = self.flops, 0.0
+        return f
+
+
+class MetricWriter:
+    """Tensorboard (+ optional wandb) scalar writer for the master loop."""
+
+    def __init__(self, tensorboard_path: Optional[str] = None,
+                 wandb_mode: str = "disabled", wandb_kwargs=None):
+        self._tb = None
+        self._wandb = None
+        if tensorboard_path:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_path)
+            except Exception:  # pragma: no cover - tb optional
+                pass
+        if wandb_mode != "disabled":  # pragma: no cover - wandb optional
+            try:
+                import wandb
+
+                wandb.init(mode=wandb_mode, **(wandb_kwargs or {}))
+                self._wandb = wandb
+            except Exception:
+                pass
+
+    def write(self, stats: Dict[str, float], step: int) -> None:
+        if self._tb is not None:
+            for k, v in stats.items():
+                self._tb.add_scalar(k, v, step)
+            self._tb.flush()
+        if self._wandb is not None:  # pragma: no cover
+            self._wandb.log(stats, step=step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()

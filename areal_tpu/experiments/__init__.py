@@ -1,0 +1,28 @@
+"""Experiment definitions (reference ``realhf/experiments/``).
+
+Each experiment class is a dataclass config (merged from YAML + CLI by
+``areal_tpu.api.cli_args``) whose ``initial_setup()`` turns the declarative
+pieces — model roles, MFC knobs, dataset, allocation mode — into the
+concrete DFG + worker configs the launcher spawns.
+"""
+
+from typing import Dict, Type
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_experiment(name: str, cls: type) -> None:
+    _REGISTRY[name] = cls
+
+
+def make_experiment_cls(name: str) -> Type:
+    # import for registration side effects
+    import areal_tpu.experiments.async_ppo_math_exp  # noqa: F401
+    import areal_tpu.experiments.ppo_math_exp  # noqa: F401
+    import areal_tpu.experiments.sft_exp  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown experiment '{name}'; have {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name]

@@ -1,0 +1,100 @@
+"""Async PPO (math/code) experiment definition.
+
+Parity target: ``realhf/experiments/async_exp/async_ppo_math_exp.py:26`` +
+``async_rl_exp.py:60`` — generation leaves the DFG (the master never sees
+``actor_gen``; rollout workers drive the generation fleet and push
+trajectories over ZMQ into the trainer's stream dataset), rewards are
+computed rollout-side by the env, and ``version_start/version_end`` keys
+ride along for the decoupled loss. The 4 rollout-side worker groups
+(generation servers, gserver manager, rollout workers + the trainer's
+puller) are generated here from the allocation mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+
+
+@dataclasses.dataclass
+class AsyncPPOMATHConfig(PPOMATHConfig):
+    """Adds the reference's AsyncRLOptions (cli_args.py:1104)."""
+
+    new_tokens_per_chunk: int = 1 << 10
+    max_head_offpolicyness: int = 0
+    n_rollout_workers: int = 1
+    max_concurrent_rollouts: int = 64
+    flush_request_timeout: int = 120
+    schedule_policy: str = "round_robin"
+    # generation-server knobs (the reference's SGLangConfig analogue)
+    gen_batch_window_ms: int = 5
+    gen_max_batch_size: int = 64
+    gen_prompt_bucket: int = 128
+
+    def initial_setup(self) -> Dict[str, Any]:
+        from areal_tpu.system.generation_server import GenerationServerConfig
+        from areal_tpu.system.gserver_manager import GserverManagerConfig
+        from areal_tpu.system.rollout_worker import RolloutWorkerConfig
+
+        alloc = C.resolve_allocation(self)
+        n_gen = 1
+        if alloc.decoupled and alloc.gen_spec is not None:
+            # One in-process server per gen data-parallel replica; tp/sp of
+            # the gen spec shard each server's decode over its slice.
+            n_gen = alloc.gen_spec.data_degree
+        paths = C.experiment_paths(self)
+        gen_servers = [
+            GenerationServerConfig(
+                experiment=self.experiment_name, trial=self.trial_name,
+                server_id=f"gen{i}",
+                chunk_tokens=self.new_tokens_per_chunk,
+                batch_window_ms=self.gen_batch_window_ms,
+                max_batch_size=self.gen_max_batch_size,
+                prompt_bucket=self.gen_prompt_bucket,
+            )
+            for i in range(n_gen)
+        ]
+        manager = GserverManagerConfig(
+            experiment=self.experiment_name, trial=self.trial_name,
+            model_role="actor", n_servers=n_gen,
+            # Staleness counts in SAMPLE (trajectory) units — reference
+            # async_rl_exp.py:327 passes train_rpcs[0].n_seqs.
+            train_batch_size=self.dataset.train_bs_n_seqs * self.group_size,
+            max_head_offpolicyness=self.max_head_offpolicyness,
+            max_concurrent_rollouts=self.max_concurrent_rollouts,
+            schedule_policy=self.schedule_policy,
+            realloc_dir=paths["realloc"],
+        )
+        rollout_workers = [
+            RolloutWorkerConfig(
+                experiment=self.experiment_name, trial=self.trial_name,
+                worker_index=i, n_workers=self.n_rollout_workers,
+                dataset_path=self.dataset.path,
+                gconfig=dataclasses.replace(
+                    self.ppo.gen, n=self.group_size
+                ),
+                group_size=self.group_size,
+                chunk_tokens=self.new_tokens_per_chunk,
+                max_concurrent=max(
+                    1, self.max_concurrent_rollouts // self.n_rollout_workers
+                ),
+                seed=self.seed + i,
+            )
+            for i in range(self.n_rollout_workers)
+        ]
+        return {
+            "dfg": self.build_dfg(self.dataset.train_bs_n_seqs,
+                                  async_mode=True),
+            "master": self.build_master_config(async_mode=True),
+            "trainer": self.build_trainer_config(async_mode=True),
+            "gen_servers": gen_servers,
+            "gserver_manager": manager,
+            "rollout_workers": rollout_workers,
+        }
+
+
+register_experiment("async-ppo-math", AsyncPPOMATHConfig)

@@ -1,0 +1,104 @@
+"""Shared experiment-building helpers.
+
+Parity target: ``realhf/experiments/common/common.py:72``
+(CommonExperimentConfig) — resolving the allocation mode, turning model
+role configs into worker configs, and sanity-checking the result. The TPU
+collapse: no RPCAllocation search over GPU sub-meshes; one trainer process
+owns the whole trainer mesh (GSPMD shards inside it), and the generation
+fleet owns a disjoint slice when the allocation mode is decoupled.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from areal_tpu.api.cli_args import (
+    BaseExperimentConfig,
+    ModelTrainEvalConfig,
+)
+from areal_tpu.parallel.mesh import AllocationMode, ParallelSpec
+
+
+def resolve_allocation(cfg: BaseExperimentConfig) -> AllocationMode:
+    """Parse ``allocation_mode`` (default: all chips, pure dp)."""
+    total = cfg.n_nodes * cfg.n_gpus_per_node
+    if not cfg.allocation_mode:
+        return AllocationMode(global_spec=ParallelSpec(dp=total))
+    return AllocationMode.parse(cfg.allocation_mode)
+
+
+def model_init_dict(mc: ModelTrainEvalConfig) -> Dict[str, Any]:
+    """ModelTrainEvalConfig → TrainerWorker ModelRoleConfig.init dict."""
+    if mc.tiny:
+        return {"tiny": dict(mc.tiny)}
+    if mc.type._class == "null" or (not mc.path and not mc.init_from_scratch):
+        return {"null": True}
+    if mc.path:
+        return {"hf_dir": mc.path}
+    raise ValueError(
+        f"model config {mc} has init_from_scratch but no size spec; "
+        "use `tiny` or provide a path"
+    )
+
+
+def backend_args_for(
+    mc: ModelTrainEvalConfig,
+    spec: Optional[ParallelSpec],
+    total_train_steps: int,
+) -> Dict[str, Any]:
+    args: Dict[str, Any] = {
+        "optimizer": mc.optimizer,
+        "compute_dtype": "bfloat16" if mc.bf16 else "float32",
+        "remat": mc.gradient_checkpointing,
+    }
+    if mc.tiny:
+        # CPU-test scale: small buckets so tiny batches don't pad to 128.
+        args.update(compute_dtype="float32", length_bucket=16,
+                    rows_bucket=2, seqs_bucket=4, remat=False)
+    if spec is not None and spec.world_size > 1:
+        args["parallel_spec"] = str(spec)
+    return args
+
+
+def make_tokenizer(cfg: BaseExperimentConfig, model_path: str):
+    if cfg.mock_tokenizer:
+        from areal_tpu.base.testing import MockTokenizer
+
+        return MockTokenizer()
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(model_path)
+
+
+def experiment_paths(cfg: BaseExperimentConfig) -> Dict[str, str]:
+    root = os.path.join(
+        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name
+    )
+    return {
+        "root": root,
+        "save": os.path.join(root, "checkpoints"),
+        "realloc": os.path.join(root, "realloc"),
+        "recover": os.path.join(root, "recover"),
+        "name_resolve": (
+            cfg.cluster.name_resolve.nfs_record_root
+            or os.path.join(root, "name_resolve")
+        ),
+        "log": os.path.join(root, "logs"),
+    }
+
+
+def setup_name_resolve(cfg: BaseExperimentConfig) -> None:
+    """Configure the process-global name-resolve repo.
+
+    Child worker processes must call this again (module globals don't cross
+    a spawn boundary). NFS roots default under the experiment fileroot.
+    """
+    import dataclasses as dc
+
+    from areal_tpu.base import name_resolve
+
+    nr = cfg.cluster.name_resolve
+    if nr.type == "nfs" and not nr.nfs_record_root:
+        nr = dc.replace(nr, nfs_record_root=experiment_paths(cfg)["name_resolve"])
+    name_resolve.reconfigure(nr)

@@ -1,0 +1,316 @@
+"""Sync PPO (math/code) experiment definition.
+
+Parity target: ``realhf/experiments/common/ppo_math_exp.py:30``
+(PPOMATHConfig) — builds the up-to-7-node PPO DFG
+
+    actor_gen → {rew_inf, ref_inf, actor_inf, critic_inf}
+              → {actor_train, critic_train}
+
+with the reference's conditional pruning:
+ - ``ppo.disable_value``     (GRPO) drops critic_inf/critic_train,
+ - ``ppo.kl_ctl == 0``       drops ref_inf,
+ - ``ppo.recompute_logprob or ppo.use_decoupled_loss`` adds actor_inf
+   (proximal-logprob recompute, the decoupled-loss center),
+ - ref-EMA via a ParamReallocHook on actor_train (``:345-364``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.algorithms.ppo import PPOHyperparameters
+from areal_tpu.api.cli_args import (
+    BaseExperimentConfig,
+    MFCConfig,
+    ModelTrainEvalConfig,
+    PromptOnlyDatasetConfig,
+)
+from areal_tpu.api.dfg import (
+    DataFlowGraph,
+    MFCDef,
+    MFCInterfaceType,
+    ModelInterfaceAbstraction,
+    ParamReallocHook,
+    WeightUpdateHook,
+    build_graph,
+)
+from areal_tpu.api.model import FinetuneSpec
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+
+# Keys produced by the generate MFC (trajectory contract, §2.9 of SURVEY).
+TRAJ_KEYS = (
+    "packed_input_ids", "prompt_mask", "packed_logprobs",
+    "seq_no_eos_mask", "task_ids", "version_start", "version_end",
+)
+
+
+@dataclasses.dataclass
+class PPOMATHConfig(BaseExperimentConfig):
+    """CLI surface mirrors the reference so run scripts port verbatim."""
+
+    actor: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=ModelTrainEvalConfig
+    )
+    ref: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=ModelTrainEvalConfig
+    )
+    critic: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=lambda: ModelTrainEvalConfig()
+    )
+    rew: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=lambda: ModelTrainEvalConfig()
+    )
+
+    actor_train: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    actor_gen: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    actor_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    critic_train: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    critic_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    rew_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    ref_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+
+    dataset: PromptOnlyDatasetConfig = dataclasses.field(
+        default_factory=PromptOnlyDatasetConfig
+    )
+    ppo: PPOHyperparameters = dataclasses.field(
+        default_factory=PPOHyperparameters
+    )
+    group_size: int = 1
+    mask_too_long: bool = False
+    ref_ema_eta: Optional[float] = None  # ref := eta*actor + (1-eta)*ref
+
+    # ---------------- derived pieces ----------------
+
+    @property
+    def _use_critic(self) -> bool:
+        return not self.ppo.disable_value
+
+    @property
+    def _use_ref(self) -> bool:
+        return self.ppo.kl_ctl != 0.0
+
+    @property
+    def _use_actor_inf(self) -> bool:
+        return self.ppo.recompute_logprob or self.ppo.use_decoupled_loss
+
+    def _hp(self) -> PPOHyperparameters:
+        hp = dataclasses.replace(self.ppo)
+        hp.group_size = self.group_size
+        return hp
+
+    def build_dfg(self, n_prompts: int, async_mode: bool = False) -> DataFlowGraph:
+        """n_prompts = train_bs_n_seqs; downstream nodes see
+        n_prompts*group_size flattened trajectories."""
+        n_traj = n_prompts * self.group_size
+        mfcs: List[MFCDef] = []
+        if not async_mode:
+            mfcs.append(MFCDef(
+                name="actor_gen", model_name="actor",
+                interface_type=MFCInterfaceType.GENERATE,
+                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                input_keys=("packed_prompts", "task_ids"),
+                output_keys=TRAJ_KEYS,
+                n_seqs=n_prompts, mb_spec=self.actor_gen.mb_spec,
+            ))
+            mfcs.append(MFCDef(
+                name="rew_inf", model_name="rew",
+                interface_type=MFCInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("rw_math_code"),
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("rewards",),
+                n_seqs=n_traj, mb_spec=self.rew_inf.mb_spec,
+            ))
+        if self._use_ref:
+            mfcs.append(MFCDef(
+                name="ref_inf", model_name="ref",
+                interface_type=MFCInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ref_logprob"),
+                input_keys=("packed_input_ids",),
+                output_keys=("packed_ref_logprobs",),
+                n_seqs=n_traj, mb_spec=self.ref_inf.mb_spec,
+            ))
+        if self._use_actor_inf:
+            mfcs.append(MFCDef(
+                name="actor_inf", model_name="actor",
+                interface_type=MFCInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                input_keys=("packed_input_ids",),
+                output_keys=("prox_logprobs",),
+                n_seqs=n_traj, mb_spec=self.actor_inf.mb_spec,
+            ))
+        if self._use_critic:
+            mfcs.append(MFCDef(
+                name="critic_inf", model_name="critic",
+                interface_type=MFCInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                input_keys=("packed_input_ids",),
+                output_keys=("values",),
+                n_seqs=n_traj, mb_spec=self.critic_inf.mb_spec,
+            ))
+        train_inputs = ["packed_input_ids", "prompt_mask", "packed_logprobs",
+                        "rewards", "seq_no_eos_mask"]
+        if self._use_ref:
+            train_inputs.append("packed_ref_logprobs")
+        if self._use_actor_inf:
+            train_inputs.append("prox_logprobs")
+        if self._use_critic:
+            train_inputs.append("values")
+        actor_post = [WeightUpdateHook(role="actor")]
+        if self.ref_ema_eta is not None:
+            actor_post.append(ParamReallocHook(
+                source="actor", target="ref", eta=self.ref_ema_eta
+            ))
+        mfcs.append(MFCDef(
+            name="actor_train", model_name="actor",
+            interface_type=MFCInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            input_keys=tuple(train_inputs),
+            n_seqs=n_traj, mb_spec=self.actor_train.mb_spec,
+            post_hooks=actor_post,
+        ))
+        if self._use_critic:
+            mfcs.append(MFCDef(
+                name="critic_train", model_name="critic",
+                interface_type=MFCInterfaceType.TRAIN_STEP,
+                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                input_keys=tuple(
+                    k for k in train_inputs if k != "prox_logprobs"
+                ),
+                n_seqs=n_traj, mb_spec=self.critic_train.mb_spec,
+            ))
+        return build_graph(mfcs)
+
+    def build_trainer_config(self, async_mode: bool = False):
+        from areal_tpu.system.trainer_worker import (
+            MFCRuntimeConfig,
+            ModelRoleConfig,
+            TrainerWorkerConfig,
+        )
+
+        alloc = C.resolve_allocation(self)
+        spec = alloc.global_spec
+        paths = C.experiment_paths(self)
+        steps_per_epoch = max(
+            1, 10000 // max(self.dataset.train_bs_n_seqs, 1)
+        )
+        total_steps = self.exp_ctrl.total_train_epochs * steps_per_epoch
+        hp = self._hp()
+
+        models: Dict[str, ModelRoleConfig] = {
+            "actor": ModelRoleConfig(
+                init=C.model_init_dict(self.actor),
+                backend_args=C.backend_args_for(self.actor, spec, total_steps),
+            ),
+        }
+        if self._use_ref:
+            models["ref"] = ModelRoleConfig(
+                init=C.model_init_dict(self.ref),
+                backend_args=C.backend_args_for(self.ref, spec, total_steps),
+                train=False,
+            )
+        if self._use_critic:
+            critic = self.critic
+            if not critic.tiny and not critic.path:
+                critic = self.actor  # default: init critic from actor shape
+            models["critic"] = ModelRoleConfig(
+                init=C.model_init_dict(critic),
+                backend_args=C.backend_args_for(critic, spec, total_steps),
+            )
+        mfcs: Dict[str, MFCRuntimeConfig] = {}
+        if not async_mode:
+            models["rew"] = ModelRoleConfig(init={"null": True}, backend="null")
+            mfcs["actor_gen"] = MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor",
+            )
+            mfcs["rew_inf"] = MFCRuntimeConfig(
+                interface="rw_math_code",
+                interface_args={"dataset_path": self.dataset.path,
+                                "group_size": self.group_size},
+                model_name="rew",
+            )
+        if self._use_ref:
+            mfcs["ref_inf"] = MFCRuntimeConfig(
+                interface="ref_logprob", model_name="ref"
+            )
+        if self._use_actor_inf:
+            mfcs["actor_inf"] = MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor",
+            )
+        if self._use_critic:
+            mfcs["critic_inf"] = MFCRuntimeConfig(
+                interface="ppo_critic", interface_args={"hp": hp},
+                model_name="critic",
+            )
+            mfcs["critic_train"] = MFCRuntimeConfig(
+                interface="ppo_critic", interface_args={"hp": hp},
+                model_name="critic",
+            )
+        mfcs["actor_train"] = MFCRuntimeConfig(
+            interface="ppo_actor", interface_args={"hp": hp},
+            model_name="actor",
+        )
+        return TrainerWorkerConfig(
+            experiment=self.experiment_name, trial=self.trial_name,
+            handler="trainer",
+            models=models, mfcs=mfcs,
+            dataset=None if async_mode else "math_code_prompt",
+            dataset_args={} if async_mode else {
+                "dataset_path": self.dataset.path,
+                "max_length": self.dataset.max_prompt_len,
+            },
+            batch_size=self.dataset.train_bs_n_seqs,
+            ft_spec=FinetuneSpec(
+                total_train_epochs=self.exp_ctrl.total_train_epochs,
+                dataset_size=10000,
+                train_batch_size=self.dataset.train_bs_n_seqs,
+            ),
+            tokenizer=None,  # resolved in-process by the launcher entry
+            stream_dataset=async_mode,
+            realloc_dir=paths["realloc"],
+        )
+
+    def build_master_config(self, async_mode: bool = False):
+        from areal_tpu.system.master_worker import MasterWorkerConfig
+
+        paths = C.experiment_paths(self)
+        # Sync mode: the master fetches PROMPTS (actor_gen flattens them into
+        # group_size trajectories in-graph). Async mode: the stream dataset
+        # yields already-flattened TRAJECTORIES, so one step consumes
+        # n_prompts*group_size samples (the train MFC's n_seqs — reference
+        # async_rl_exp.py:327 uses train_rpcs[0].n_seqs the same way).
+        bs = self.dataset.train_bs_n_seqs
+        if async_mode:
+            bs *= self.group_size
+        import os
+
+        return MasterWorkerConfig(
+            experiment=self.experiment_name, trial=self.trial_name,
+            trainer_handler="trainer",
+            train_batch_size=bs,
+            exp_ctrl=self.exp_ctrl,
+            save_dir=paths["save"],
+            src_is_stream=async_mode,
+            tensorboard_path=(
+                self.tensorboard.path
+                or os.path.join(paths["log"], "tensorboard")
+            ),
+            wandb_mode=self.wandb.mode,
+            recover_dir=paths["recover"],
+            recover=self.recover_mode == "resume",
+        )
+
+    def initial_setup(self) -> Dict[str, Any]:
+        """→ {dfg, master, trainer} (sync: everything on the trainer mesh)."""
+        return {
+            "dfg": self.build_dfg(self.dataset.train_bs_n_seqs,
+                                  async_mode=False),
+            "master": self.build_master_config(async_mode=False),
+            "trainer": self.build_trainer_config(async_mode=False),
+        }
+
+
+register_experiment("ppo-math", PPOMATHConfig)

@@ -1,0 +1,91 @@
+"""SFT experiment definition (reference ``realhf/experiments/common/sft_exp.py``).
+
+One-node DFG: ``trainDefault`` TRAIN_STEP on the packed CE interface over
+``packed_input_ids`` + ``prompt_mask`` batches from the prompt-answer
+dataset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from areal_tpu.api.cli_args import (
+    BaseExperimentConfig,
+    MFCConfig,
+    ModelTrainEvalConfig,
+    PromptAnswerDatasetConfig,
+)
+from areal_tpu.api.dfg import (
+    MFCDef,
+    MFCInterfaceType,
+    ModelInterfaceAbstraction,
+    build_graph,
+)
+from areal_tpu.api.model import FinetuneSpec
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+
+
+@dataclasses.dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=ModelTrainEvalConfig
+    )
+    allocation: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    dataset: PromptAnswerDatasetConfig = dataclasses.field(
+        default_factory=PromptAnswerDatasetConfig
+    )
+
+    def initial_setup(self) -> Dict[str, Any]:
+        from areal_tpu.system.master_worker import MasterWorkerConfig
+        from areal_tpu.system.trainer_worker import (
+            MFCRuntimeConfig,
+            ModelRoleConfig,
+            TrainerWorkerConfig,
+        )
+
+        alloc = C.resolve_allocation(self)
+        paths = C.experiment_paths(self)
+        dfg = build_graph([MFCDef(
+            name="trainDefault", model_name="default",
+            interface_type=MFCInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("sft"),
+            input_keys=("packed_input_ids", "prompt_mask"),
+            n_seqs=self.dataset.train_bs_n_seqs,
+            mb_spec=self.allocation.mb_spec,
+        )])
+        trainer = TrainerWorkerConfig(
+            experiment=self.experiment_name, trial=self.trial_name,
+            handler="trainer",
+            models={"default": ModelRoleConfig(
+                init=C.model_init_dict(self.model),
+                backend_args=C.backend_args_for(
+                    self.model, alloc.global_spec, 10000
+                ),
+            )},
+            mfcs={"trainDefault": MFCRuntimeConfig(
+                interface="sft", model_name="default"
+            )},
+            dataset="prompt_answer",
+            dataset_args={"dataset_path": self.dataset.path,
+                          "max_length": self.dataset.max_seqlen},
+            batch_size=self.dataset.train_bs_n_seqs,
+            ft_spec=FinetuneSpec(
+                total_train_epochs=self.exp_ctrl.total_train_epochs,
+                dataset_size=10000,
+                train_batch_size=self.dataset.train_bs_n_seqs,
+            ),
+            realloc_dir=paths["realloc"],
+        )
+        master = MasterWorkerConfig(
+            experiment=self.experiment_name, trial=self.trial_name,
+            trainer_handler="trainer",
+            train_batch_size=self.dataset.train_bs_n_seqs,
+            exp_ctrl=self.exp_ctrl,
+            save_dir=paths["save"],
+        )
+        return {"dfg": dfg, "master": master, "trainer": trainer}
+
+
+register_experiment("sft", SFTConfig)

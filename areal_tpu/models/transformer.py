@@ -173,12 +173,19 @@ def _block(
         new_kv = (k, v)
     else:
         k_cache, v_cache = cache_kv
-        k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k, cache_write_index, axis=1
-        )
-        v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v, cache_write_index, axis=1
-        )
+        if getattr(cache_write_index, "ndim", 0) == 1:
+            # Per-row write slots (continuous batching: rows of the batch
+            # sit at different sequence lengths). T must be 1.
+            rows = jnp.arange(B)
+            k_cache = k_cache.at[rows, cache_write_index].set(k[:, 0])
+            v_cache = v_cache.at[rows, cache_write_index].set(v[:, 0])
+        else:
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k, cache_write_index, axis=1
+            )
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v, cache_write_index, axis=1
+            )
         attn = decode_attention(q, k_cache, v_cache, kv_valid)
         new_kv = (k_cache, v_cache)
 

@@ -51,16 +51,37 @@ class GenerationServerConfig:
     eos_token_id: int = 1
     pad_token_id: int = 0
     port: Optional[int] = None
+    # Persistent-KV continuous batching: keep per-request decode state so a
+    # chunk continuation decodes from its cache instead of re-prefilling the
+    # whole prefix (the reference's SGLang radix-cache role). 0 disables.
+    kv_slots: int = 256
+    kv_bucket: int = 256  # KV capacity granularity (slots)
 
 
 class _Pending:
-    __slots__ = ("prompt", "gconfig", "future", "max_tokens")
+    __slots__ = ("rid", "prompt", "gconfig", "future", "max_tokens",
+                 "tokens_done")
 
-    def __init__(self, prompt, gconfig, max_tokens, future):
+    def __init__(self, prompt, gconfig, max_tokens, future, rid=None,
+                 tokens_done=0):
+        self.rid = rid
         self.prompt = prompt
         self.gconfig = gconfig
         self.max_tokens = max_tokens
+        self.tokens_done = tokens_done
         self.future = future
+
+
+class _ReqState:
+    """Server-resident decode state of one in-flight chunked request."""
+
+    __slots__ = ("state", "cur_len", "version", "last_used")
+
+    def __init__(self, state, cur_len: int, version: int):
+        self.state = state  # single-row decode state (models.generate)
+        self.cur_len = cur_len
+        self.version = version
+        self.last_used = time.monotonic()
 
 
 class GenerationServer:
@@ -84,13 +105,16 @@ class GenerationServer:
         self._queue: asyncio.Queue = None  # created on loop start
         self._key = jax.random.PRNGKey(0)
         self._tokens_out = 0
+        self._prefill_tokens = 0
         self._t_start = time.monotonic()
         self._runner_task = None
+        self._states: Dict[str, _ReqState] = {}
 
     # ---------------- decode core ----------------
 
     def _decode_batch(self, batch: List[_Pending]) -> List[Dict[str, Any]]:
         import jax
+        import jax.numpy as jnp
 
         cfg = self.cfg
         # Capture (params, version) atomically: handle_update_weights swaps
@@ -98,38 +122,112 @@ class GenerationServer:
         # sampled under the old weights must be tagged with the version
         # that actually produced them (decoupled-loss bookkeeping).
         params, version = self.params, self.version
+        # _runner groups the batch by identical gconfig, which includes the
+        # requested chunk length — so this is uniform across the batch (and
+        # decode_chunk recompiles only per distinct final-chunk size).
         chunk = min(cfg.chunk_tokens, max(p.max_tokens for p in batch))
-        prompts = [p.prompt for p in batch]
-        padded, plens = genmod.pad_prompts(
-            prompts, cfg.pad_token_id, bucket=cfg.prompt_bucket
-        )
-        self._key, sub = jax.random.split(self._key)
-        # _runner groups the batch by identical sampling params.
         gconfig = batch[0].gconfig
-        out = genmod.generate_batch(
-            params, self.model_cfg, padded, plens, sub,
-            gconfig, max_new_tokens=chunk,
-            eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
-        )
-        res = []
-        for i, p in enumerate(batch):
-            # Never hand back more than the request's remaining budget —
-            # the client appends every token we return.
-            n = min(int(out["output_lens"][i]), p.max_tokens)
-            toks = np.asarray(out["output_ids"][i][:n])
-            lps = np.asarray(out["output_logprobs"][i][:n])
-            # "finished" = the MODEL ended the sequence (EOS). Budget
-            # exhaustion is the client's call — it knows the total budget
-            # across chunks, we only see this chunk's slice.
-            emitted_eos = bool((toks == cfg.eos_token_id).any())
-            res.append({
-                "output_ids": toks.tolist(),
-                "output_logprobs": lps.tolist(),
-                "finished": emitted_eos,
-                "version": version,
-            })
-            self._tokens_out += n
-        return res
+
+        # Split: requests whose decode state survived (same version, prefix
+        # length matches) continue from their KV; the rest prefill.
+        cont: List[_Pending] = []
+        fresh: List[_Pending] = []
+        for p in batch:
+            st = None
+            if p.rid is not None and cfg.kv_slots > 0:
+                st = self._states.get(p.rid)
+            if (
+                st is not None and st.version == version
+                and st.cur_len == len(p.prompt)
+            ):
+                st.last_used = time.monotonic()
+                cont.append(p)
+            else:
+                fresh.append(p)
+
+        row_states = {}
+        if fresh:
+            padded, plens = genmod.pad_prompts(
+                [p.prompt for p in fresh], cfg.pad_token_id,
+                bucket=cfg.prompt_bucket,
+            )
+            S = self._round_capacity(padded.shape[1] + chunk)
+            st = genmod.prefill_state(
+                params, self.model_cfg, jnp.asarray(padded),
+                jnp.asarray(plens), S,
+            )
+            self._prefill_tokens += int(plens.sum())
+            for i, p in enumerate(fresh):
+                row_states[id(p)] = genmod.slice_state(st, i)
+        for p in cont:
+            rs = self._states[p.rid]
+            row_states[id(p)] = genmod.grow_state(
+                rs.state, self._round_capacity(rs.cur_len + chunk)
+            )
+
+        # Group rows by KV capacity (static shape per decode_chunk call).
+        groups: Dict[int, List[_Pending]] = {}
+        for p in batch:
+            S = row_states[id(p)]["kv_k"].shape[2]
+            groups.setdefault(S, []).append(p)
+
+        res_by_id: Dict[int, Dict[str, Any]] = {}
+        for S, group in groups.items():
+            stacked = genmod.stack_states([row_states[id(p)] for p in group])
+            done = jnp.asarray([p.tokens_done for p in group], jnp.int32)
+            self._key, sub = jax.random.split(self._key)
+            new_state, out = genmod.decode_chunk(
+                params, self.model_cfg, stacked, done, sub, gconfig,
+                n_tokens=chunk,
+                eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
+            )
+            out = jax.device_get(out)
+            for i, p in enumerate(group):
+                # Never hand back more than the request's remaining budget —
+                # the client appends every token we return.
+                n = min(int(out["output_lens"][i]), p.max_tokens)
+                toks = np.asarray(out["output_ids"][i][:n])
+                lps = np.asarray(out["output_logprobs"][i][:n])
+                # "finished" = the MODEL ended the sequence (EOS). Budget
+                # exhaustion is the client's call — it knows the total
+                # budget across chunks, we only see this chunk's slice.
+                emitted_eos = bool((toks == cfg.eos_token_id).any())
+                res_by_id[id(p)] = {
+                    "output_ids": toks.tolist(),
+                    "output_logprobs": lps.tolist(),
+                    "finished": emitted_eos,
+                    "version": version,
+                }
+                self._tokens_out += n
+                if p.rid is not None and cfg.kv_slots > 0:
+                    if emitted_eos or n >= p.max_tokens:
+                        self._states.pop(p.rid, None)
+                    elif n == chunk:
+                        # Keep state only if the client's next prefix will
+                        # be exactly prompt+chunk (budget truncation would
+                        # desync cur_len; those re-prefill).
+                        self._states[p.rid] = _ReqState(
+                            genmod.slice_state(new_state, i),
+                            cur_len=len(p.prompt) + n,
+                            version=version,
+                        )
+                    else:
+                        self._states.pop(p.rid, None)
+        self._evict_states()
+        return [res_by_id[id(p)] for p in batch]
+
+    def _round_capacity(self, n: int) -> int:
+        b = self.cfg.kv_bucket
+        return ((n + b - 1) // b) * b
+
+    def _evict_states(self) -> None:
+        cap = self.cfg.kv_slots
+        if cap <= 0:
+            self._states.clear()
+            return
+        while len(self._states) > cap:
+            oldest = min(self._states, key=lambda r: self._states[r].last_used)
+            del self._states[oldest]
 
     async def _runner(self):
         cfg = self.cfg
@@ -172,6 +270,8 @@ class GenerationServer:
             gconfig=gconfig,
             max_tokens=int(d.get("max_tokens", gconfig.max_new_tokens)),
             future=fut,
+            rid=d.get("rid"),
+            tokens_done=int(d.get("tokens_done", 0)),
         ))
         return web.json_response(await fut)
 
@@ -193,6 +293,10 @@ class GenerationServer:
         )
         self.params = new
         self.version = int(d.get("version", self.version + 1))
+        # KV computed under the old weights is stale — continuations after
+        # a version change re-prefill once (reference: SGLang flushes its
+        # cache on update_weights_from_disk).
+        self._states.clear()
         dt = time.monotonic() - t0
         logger.info(f"weights updated to v{self.version} in {dt:.2f}s")
         from aiohttp import web
@@ -211,7 +315,9 @@ class GenerationServer:
         dt = max(time.monotonic() - self._t_start, 1e-6)
         return web.json_response({
             "generated_tokens": self._tokens_out,
+            "prefill_tokens": self._prefill_tokens,
             "tokens_per_sec": self._tokens_out / dt,
+            "kv_states": len(self._states),
             "version": self.version,
         })
 

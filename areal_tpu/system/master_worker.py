@@ -62,6 +62,14 @@ class MasterWorkerConfig:
     save_dir: str = "/tmp/areal_tpu/ckpt"
     # async mode: generation happens outside the DFG (rollout workers)
     src_is_stream: bool = False
+    # observability (reference master_worker.py:291-350)
+    tensorboard_path: Optional[str] = None
+    wandb_mode: str = "disabled"
+    # recover checkpoints (RecoverInfo + trainer train-state) live here
+    recover_dir: str = ""
+    # resume from the latest recover checkpoint at startup
+    recover: bool = False
+    keep_recover_ckpts: int = 2
 
 
 class MasterWorker:
@@ -94,9 +102,93 @@ class MasterWorker:
     # ---------------- setup ----------------
 
     def setup(self) -> None:
+        from areal_tpu.base import monitor
+
         self.stream = MasterRequestStream(
             self.cfg.experiment, self.cfg.trial, [self.cfg.trainer_handler]
         )
+        self._model_info = self.stream.call(
+            self.cfg.trainer_handler, "model_info", None
+        )
+        self._peak_flops = monitor.device_peak_flops(
+            self._model_info.get("device_kind", "")
+        )
+        self._flops = monitor.FlopsCounter()
+        self._writer = monitor.MetricWriter(
+            tensorboard_path=self.cfg.tensorboard_path,
+            wandb_mode=self.cfg.wandb_mode,
+        )
+        if self.cfg.recover and self.cfg.recover_dir:
+            self._try_recover()
+
+    def _try_recover(self) -> None:
+        """Resume from the latest recover checkpoint (reference
+        master_worker.py:585 dump / recover.discover_ckpt)."""
+        from areal_tpu.base import recover
+
+        info = recover.load(self.cfg.recover_dir)
+        ckpt = recover.discover_ckpt(self.cfg.recover_dir)
+        if info is None or ckpt is None:
+            logger.info("recover requested but no checkpoint found; "
+                        "starting fresh")
+            return
+        self.step = info.last_step_info.global_step
+        self.epoch = info.last_step_info.epoch
+        reply = self.stream.call(
+            self.cfg.trainer_handler, "restore", {"dir": ckpt}
+        )
+        logger.info(
+            f"recovered at step {self.step} epoch {self.epoch} from {ckpt} "
+            f"(model versions: {reply.get('versions')})"
+        )
+
+    def _do_ckpt(self) -> None:
+        from areal_tpu.base import recover
+
+        if not self.cfg.recover_dir:
+            return
+        name = recover.ckpt_dirname(self.epoch, self.step, self.step)
+        ckpt_dir = f"{self.cfg.recover_dir}/{name}"
+        self.stream.call(self.cfg.trainer_handler, "ckpt", {"dir": ckpt_dir})
+        si = recover.StepInfo(self.epoch, self.step, self.step)
+        recover.dump(self.cfg.recover_dir, recover.RecoverInfo(
+            recover_start=si, last_step_info=si,
+        ))
+        # GC old recover ckpts (they are large: params + optimizer state).
+        import os
+        import shutil
+
+        entries = []
+        for n in os.listdir(self.cfg.recover_dir):
+            st = recover.parse_ckpt_dirname(n)
+            if st is not None:
+                entries.append((st.global_step, n))
+        for _, n in sorted(entries)[: -self.cfg.keep_recover_ckpts]:
+            shutil.rmtree(f"{self.cfg.recover_dir}/{n}", ignore_errors=True)
+
+    def _count_mfc_flops(self, node: MFCDef, metas: List[SequenceSample]) -> None:
+        """Analytic FLOPs for one MFC from input metadata (lengths only)."""
+        info = self._model_info.get("roles", {}).get(node.model_name)
+        if info is None or not metas:
+            return
+        key = next(iter(metas[0].seqlens))
+        lens = [sum(m.seqlens[key][0]) for m in metas]
+        n_tokens = float(sum(lens))
+        avg = n_tokens / max(len(lens), 1)
+
+        class _C:  # adapter: monitor formulas take config-like fields
+            n_layers = info["n_layers"]
+            hidden_dim = info["hidden_dim"]
+            q_dim = info["q_dim"]
+            kv_dim = info["kv_dim"]
+            intermediate_dim = info["intermediate_dim"]
+            vocab_size = info["vocab_size"]
+            is_critic = info["is_critic"]
+
+        if node.interface_type == MFCInterfaceType.TRAIN_STEP:
+            self._flops.add_train(_C, n_tokens, avg)
+        else:
+            self._flops.add_inf(_C, n_tokens, avg)
 
     # ---------------- per-step DFG traversal ----------------
 
@@ -128,6 +220,8 @@ class MasterWorker:
         metas = await self.buffer.get_batch_for_rpc(
             node.name, set(node.input_keys), node.n_seqs
         )
+        t_mfc = time.monotonic()
+        self._count_mfc_flops(node, metas)
         ids = [m.ids[0] for m in metas]
         payload = Payload(
             handler=self.cfg.trainer_handler,
@@ -164,6 +258,9 @@ class MasterWorker:
         else:
             if out["meta"] is not None:
                 await self.buffer.amend_batch(out["meta"])
+        self.stats.scalar(**{
+            f"timeperf/{node.name}": time.monotonic() - t_mfc
+        })
 
     async def _execute_step(self) -> None:
         tasks = [self._load_data()]
@@ -191,8 +288,19 @@ class MasterWorker:
             await self._execute_step()
             self.step += 1
             step_stats = self.stats.export(reset=True)
-            step_stats["timeperf/e2e"] = time.monotonic() - t0
+            dt = time.monotonic() - t0
+            step_stats["timeperf/e2e"] = dt
+            # Analytic TFLOP/s per chip + MFU (reference master_worker.py:497
+            # tabulates the FlopsCounter the same way).
+            n_chips = max(self._model_info.get("n_devices", 1), 1)
+            flops = self._flops.pop()
+            if flops > 0:
+                per_chip = flops / dt / n_chips
+                step_stats["timeperf/tflops_per_chip"] = per_chip / 1e12
+                if self._peak_flops:
+                    step_stats["timeperf/mfu"] = per_chip / self._peak_flops
             self._stats_history.append(step_stats)
+            self._writer.write(step_stats, self.step)
             logger.info(
                 f"step {self.step} epoch {self.epoch} "
                 f"({step_stats['timeperf/e2e']:.2f}s): "
@@ -201,8 +309,10 @@ class MasterWorker:
                     if "/" in k
                 )
             )
-            if self._save_ctl.check(epochs=0, steps=1):
+            if self._save_ctl.check(epochs=self.epoch, steps=self.step):
                 await asyncio.to_thread(self._request_save)
+            if self._ckpt_ctl.check(epochs=self.epoch, steps=self.step):
+                await asyncio.to_thread(self._do_ckpt)
             # post-step GC: tell the trainer which samples were fully
             # consumed so its tensor store can drop them.
             freed = await self.buffer.pop_freed()
@@ -214,6 +324,7 @@ class MasterWorker:
         await asyncio.to_thread(
             self.stream.call, self.cfg.trainer_handler, "exit"
         )
+        self._writer.close()
         return {"steps": self.step, "stats": self._stats_history}
 
     def _request_save(self) -> None:

@@ -11,6 +11,7 @@ unchanged; group ``group_size`` samples per prompt into one bundle with
 from __future__ import annotations
 
 import dataclasses
+import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -45,9 +46,17 @@ class PartialRolloutClient:
         ) as r:
             return await r.json()
 
-    async def _release(self, url: str) -> None:
-        await self.session.post(f"{self.manager_url}/release",
-                                json={"url": url})
+    async def _release(self, route: Dict) -> None:
+        await self.session.post(
+            f"{self.manager_url}/release",
+            json={"lease_id": route.get("lease_id"), "url": route["url"]},
+        )
+
+    async def _renew(self, route: Dict) -> None:
+        lid = route.get("lease_id")
+        if lid is not None:
+            await self.session.post(f"{self.manager_url}/renew",
+                                    json={"lease_id": lid})
 
     async def generate_one(
         self,
@@ -60,41 +69,49 @@ class PartialRolloutClient:
         version_start: Optional[int] = None
         version_end = 0
         n_chunks = 0
-        sticky: Optional[Dict] = None
-        while len(acc_ids) < gconfig.max_new_tokens:
-            # sticky routing while version unchanged (reference :181)
-            route = sticky or await self._schedule()
-            url = route["url"]
-            left = gconfig.max_new_tokens - len(acc_ids)
-            body = {
-                "prompt_ids": list(prompt_ids) + acc_ids,
-                "gconfig": {
-                    **dataclasses.asdict(gconfig),
-                    "max_new_tokens": min(self.chunk_tokens, left),
-                    "n": 1,
-                },
-                "max_tokens": min(self.chunk_tokens, left),
-            }
-            try:
+        # The lease is held for the whole sticky lifetime (not just the
+        # first chunk) so the manager's least_requests accounting sees the
+        # server as busy; renewed each chunk, released on route drop/end.
+        route: Optional[Dict] = None
+        rid = uuid.uuid4().hex  # keys the server's persistent decode state
+        try:
+            while len(acc_ids) < gconfig.max_new_tokens:
+                # sticky routing while version unchanged (reference :181)
+                if route is None:
+                    route = await self._schedule()
+                url = route["url"]
+                left = gconfig.max_new_tokens - len(acc_ids)
+                body = {
+                    "rid": rid,
+                    "tokens_done": len(acc_ids),
+                    "prompt_ids": list(prompt_ids) + acc_ids,
+                    "gconfig": {
+                        **dataclasses.asdict(gconfig),
+                        "max_new_tokens": min(self.chunk_tokens, left),
+                        "n": 1,
+                    },
+                    "max_tokens": min(self.chunk_tokens, left),
+                }
                 async with self.session.post(f"{url}/generate",
                                              json=body) as r:
                     out = await r.json()
-            finally:
-                if sticky is None:
-                    await self._release(url)
-            n_chunks += 1
-            acc_ids += list(out["output_ids"])
-            acc_lps += list(out["output_logprobs"])
-            v = int(out["version"])
-            if version_start is None:
-                version_start = v
-            if v == route.get("version", v):
-                sticky = route
-            else:
-                sticky = None
-            version_end = v
-            if out["finished"] or not out["output_ids"]:
-                break
+                n_chunks += 1
+                acc_ids += list(out["output_ids"])
+                acc_lps += list(out["output_logprobs"])
+                v = int(out["version"])
+                if version_start is None:
+                    version_start = v
+                version_end = v
+                if out["finished"] or not out["output_ids"]:
+                    break
+                if v == route.get("version", v):
+                    await self._renew(route)  # stay sticky
+                else:
+                    await self._release(route)
+                    route = None  # version moved: re-schedule next chunk
+        finally:
+            if route is not None:
+                await self._release(route)
         return GenResult(
             output_ids=acc_ids,
             output_logprobs=acc_lps,

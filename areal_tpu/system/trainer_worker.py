@@ -305,10 +305,94 @@ class TrainerWorker:
         )
         logger.info(f"published {role} weights v{version} -> {path}")
 
+    def _handle_model_info(self) -> Dict[str, Any]:
+        """Model geometry + device info for the master's FLOPs/MFU logging
+        (reference FlopsCounter inputs, flops_counter.py:15)."""
+        import jax
+
+        from areal_tpu.models.transformer import param_count
+
+        info: Dict[str, Any] = {
+            "n_devices": jax.device_count(),
+            "device_kind": str(jax.devices()[0]),
+            "roles": {},
+        }
+        for role, m in self.models.items():
+            engine = m.module
+            cfg = getattr(engine, "cfg", None)
+            if cfg is None:
+                continue
+            info["roles"][role] = {
+                "n_layers": cfg.n_layers, "hidden_dim": cfg.hidden_dim,
+                "q_dim": cfg.q_dim, "kv_dim": cfg.kv_dim,
+                "intermediate_dim": cfg.intermediate_dim,
+                "vocab_size": cfg.vocab_size, "is_critic": cfg.is_critic,
+                "n_params": param_count(cfg),
+            }
+        return info
+
     def _handle_clear(self, p: Payload) -> Any:
         for sid in p.data or []:
             self.store.pop(sid, None)
         return {"n_stored": len(self.store)}
+
+    # ---------------- checkpoint / restore ----------------
+    #
+    # Parity: the reference's recover checkpoints save optimizer shards +
+    # interface state so a restarted run continues the same trajectory
+    # (megatron.py:711-760, master_worker.py:585). One "ckpt" request saves
+    # every trainable role's (params, opt_state, version) + per-MFC
+    # interface state (kl controller, value RMS) + the dataset cursor.
+
+    def _handle_ckpt(self, p: Payload) -> Any:
+        import json
+
+        ckpt_dir = p.data["dir"]
+        os.makedirs(ckpt_dir, exist_ok=True)
+        meta: Dict[str, Any] = {
+            "versions": {}, "epoch": self._epoch, "epoch_pos": self._epoch_pos,
+        }
+        for role, model in self.models.items():
+            engine = model.module
+            if hasattr(engine, "save_train_state"):
+                engine.save_train_state(os.path.join(ckpt_dir, role))
+            meta["versions"][role] = model.version.global_step
+        iface_states = {}
+        for mfc_name, iface in self.interfaces.items():
+            if hasattr(iface, "state_dict"):
+                iface_states[mfc_name] = iface.state_dict()
+        with open(os.path.join(ckpt_dir, "trainer_state.json"), "w") as f:
+            json.dump({"meta": meta, "interfaces": iface_states}, f)
+        logger.info(f"checkpointed trainer state -> {ckpt_dir}")
+        return {"ok": True}
+
+    def _handle_restore(self, p: Payload) -> Any:
+        import json
+
+        ckpt_dir = p.data["dir"]
+        with open(os.path.join(ckpt_dir, "trainer_state.json")) as f:
+            d = json.load(f)
+        meta = d["meta"]
+        for role, model in self.models.items():
+            engine = model.module
+            role_dir = os.path.join(ckpt_dir, role)
+            if hasattr(engine, "load_train_state") and os.path.isdir(role_dir):
+                engine.load_train_state(role_dir)
+            model.version.global_step = int(meta["versions"].get(role, 0))
+        for mfc_name, st in d["interfaces"].items():
+            iface = self.interfaces.get(mfc_name)
+            if iface is not None and hasattr(iface, "load_state_dict"):
+                iface.load_state_dict(st)
+        self._epoch = int(meta["epoch"])
+        self._epoch_pos = int(meta["epoch_pos"])
+        if self._dataset is not None:
+            # Same seed ⇒ same permutation; restoring (epoch, pos) resumes
+            # the dataset exactly where the checkpoint left it, so consumed
+            # samples are not retrained (reference hash_vals_to_ignore).
+            rng = np.random.RandomState(self._epoch + 1)
+            self._data_iter = list(rng.permutation(len(self._dataset)))
+        logger.info(f"restored trainer state from {ckpt_dir}")
+        return {"ok": True, "versions": meta["versions"]}
 
     # ---------------- loop ----------------
 
@@ -327,6 +411,12 @@ class TrainerWorker:
                 p.output = {
                     r: m.version.global_step for r, m in self.models.items()
                 }
+            elif p.handle_name == "model_info":
+                p.output = self._handle_model_info()
+            elif p.handle_name == "ckpt":
+                p.output = self._handle_ckpt(p)
+            elif p.handle_name == "restore":
+                p.output = self._handle_restore(p)
             elif p.handle_name == "exit":
                 p.output = "bye"
                 self._exiting = True

@@ -1,0 +1,107 @@
+"""Config tree + CLI/YAML merge tests (reference hydra-merge behavior)."""
+
+import dataclasses
+
+import pytest
+
+from areal_tpu.api import cli_args as CA
+from areal_tpu.experiments.async_ppo_math_exp import AsyncPPOMATHConfig
+from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+from areal_tpu.experiments.sft_exp import SFTConfig
+
+
+def test_basic_overrides_types():
+    cfg = PPOMATHConfig()
+    CA.apply_overrides(cfg, [
+        "experiment_name=myexp",
+        "seed=7",
+        "group_size=8",
+        "ppo.gen.max_new_tokens=4096",
+        "ppo.ppo_n_minibatches=4",
+        "ppo.disable_value=true",
+        "ppo.c_clip=2.5",
+        "actor.type._class=qwen3",
+        "actor.path=/ckpt/qwen3",
+        "dataset.train_bs_n_seqs=32",
+        "actor_train.mb_spec.max_tokens_per_mb=32768",
+    ])
+    assert cfg.experiment_name == "myexp"
+    assert cfg.seed == 7 and isinstance(cfg.seed, int)
+    assert cfg.group_size == 8
+    assert cfg.ppo.gen.max_new_tokens == 4096
+    assert cfg.ppo.disable_value is True
+    assert cfg.ppo.c_clip == 2.5
+    assert cfg.actor.type._class == "qwen3"
+    assert cfg.dataset.train_bs_n_seqs == 32
+    assert cfg.actor_train.mb_spec.max_tokens_per_mb == 32768
+
+
+def test_run_async_ppo_sh_knobs_port_verbatim():
+    """The exact CLI surface of examples/run_async_ppo.sh must parse."""
+    cfg = AsyncPPOMATHConfig()
+    CA.apply_overrides(cfg, [
+        "n_nodes=1", "n_gpus_per_node=8",
+        "allocation_mode=gen.d4+d2f2t2",
+        "cluster.fileroot=/tmp/areal_tpu_exps",
+        "actor.type._class=qwen3", "actor.path=Qwen/Qwen3-1.7B",
+        "ref.type._class=qwen3", "ref.path=Qwen/Qwen3-1.7B",
+        "dataset.path=/data/boba.jsonl", "dataset.train_bs_n_seqs=32",
+        "group_size=8",
+        "ppo.gen.max_new_tokens=4096", "ppo.ppo_n_minibatches=4",
+        "actor_train.mb_spec.max_tokens_per_mb=32768",
+        "actor_inf.mb_spec.max_tokens_per_mb=32768",
+        "max_concurrent_rollouts=16", "max_head_offpolicyness=4",
+    ])
+    assert cfg.max_head_offpolicyness == 4
+    assert cfg.allocation_mode == "gen.d4+d2f2t2"
+
+
+def test_typo_raises_with_suggestion():
+    cfg = PPOMATHConfig()
+    with pytest.raises(CA.ConfigError, match="group_size"):
+        CA.apply_overrides(cfg, ["goup_size=8"])
+    with pytest.raises(CA.ConfigError, match="unknown config key"):
+        CA.apply_overrides(cfg, ["ppo.gen.maxnewtoken=1"])
+    with pytest.raises(CA.ConfigError, match="key=value"):
+        CA.apply_overrides(cfg, ["justaword"])
+
+
+def test_none_and_dict_leaves():
+    cfg = PPOMATHConfig()
+    CA.apply_overrides(cfg, [
+        "ppo.behav_imp_weight_cap=none",
+        "actor.tiny.vocab_size=258",
+        "actor.tiny.seed=0",
+    ])
+    assert cfg.ppo.behav_imp_weight_cap is None
+    assert cfg.actor.tiny == {"vocab_size": 258, "seed": 0}
+
+
+def test_yaml_round_trip(tmp_path):
+    cfg = AsyncPPOMATHConfig()
+    CA.apply_overrides(cfg, [
+        "trial_name=t0", "group_size=4", "ppo.kl_ctl=0.0",
+        "new_tokens_per_chunk=64",
+    ])
+    p = str(tmp_path / "config.yaml")
+    CA.save_yaml(cfg, p)
+    cfg2 = AsyncPPOMATHConfig()
+    CA.load_yaml(cfg2, p)
+    assert cfg2.group_size == 4
+    assert cfg2.ppo.kl_ctl == 0.0
+    assert cfg2.new_tokens_per_chunk == 64
+    assert dataclasses.asdict(cfg2) == dataclasses.asdict(cfg)
+
+
+def test_yaml_unknown_key_raises(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("ppo:\n  epss_clip: 0.3\n")
+    with pytest.raises(CA.ConfigError, match="eps_clip"):
+        CA.load_yaml(PPOMATHConfig(), str(p))
+
+
+def test_sft_config_smoke():
+    cfg = SFTConfig()
+    CA.apply_overrides(cfg, ["model.path=/x", "dataset.path=/y.jsonl",
+                             "dataset.train_bs_n_seqs=16"])
+    assert cfg.dataset.train_bs_n_seqs == 16

@@ -1,0 +1,105 @@
+"""Experiment-definition tests: DFG pruning + worker-config generation
+(reference tests/experiments semantics for PPOMATHConfig/AsyncPPOMATHConfig)."""
+
+from areal_tpu.api import cli_args as CA
+from areal_tpu.api.dfg import MFCInterfaceType
+from areal_tpu.experiments.async_ppo_math_exp import AsyncPPOMATHConfig
+from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+
+
+def _tiny(cfg):
+    CA.apply_overrides(cfg, [
+        "trial_name=t0",
+        "mock_tokenizer=true",
+        "actor.tiny.vocab_size=258",
+        "ref.tiny.vocab_size=258",
+        "dataset.path=/tmp/none.jsonl",
+        "dataset.train_bs_n_seqs=4",
+        "group_size=2",
+    ])
+    return cfg
+
+
+def test_sync_full_dfg_grpo_decoupled():
+    cfg = _tiny(PPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.disable_value=true", "ppo.use_decoupled_loss=true",
+        "ppo.kl_ctl=0.05",
+    ])
+    dfg = cfg.build_dfg(4)
+    names = set(dfg.nodes)
+    assert names == {"actor_gen", "rew_inf", "ref_inf", "actor_inf",
+                     "actor_train"}
+    # flattened group sizes: downstream nodes see n_prompts*group_size
+    assert dfg.nodes["actor_gen"].n_seqs == 4
+    assert dfg.nodes["actor_train"].n_seqs == 8
+    assert "prox_logprobs" in dfg.nodes["actor_train"].input_keys
+    assert "packed_ref_logprobs" in dfg.nodes["actor_train"].input_keys
+
+
+def test_sync_dfg_pruning():
+    # kl_ctl=0 drops ref_inf; no recompute/decoupled drops actor_inf;
+    # critic on → critic nodes present.
+    cfg = _tiny(PPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.kl_ctl=0.0", "ppo.disable_value=false",
+        "ppo.use_decoupled_loss=false", "ppo.recompute_logprob=false",
+        "critic.tiny.vocab_size=258",
+    ])
+    dfg = cfg.build_dfg(4)
+    names = set(dfg.nodes)
+    assert names == {"actor_gen", "rew_inf", "critic_inf", "critic_train",
+                     "actor_train"}
+    assert "values" in dfg.nodes["actor_train"].input_keys
+    assert dfg.nodes["critic_train"].interface_type == MFCInterfaceType.TRAIN_STEP
+
+
+def test_async_dfg_has_no_gen_or_rew():
+    cfg = _tiny(AsyncPPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.disable_value=true", "ppo.use_decoupled_loss=true",
+        "ppo.kl_ctl=0.05",
+    ])
+    dfg = cfg.build_dfg(4, async_mode=True)
+    assert set(dfg.nodes) == {"ref_inf", "actor_inf", "actor_train"}
+
+
+def test_initial_setup_generates_worker_configs():
+    cfg = _tiny(AsyncPPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.disable_value=true", "ppo.use_decoupled_loss=true",
+        "ppo.kl_ctl=0.05", "allocation_mode=gen.d2+d4",
+        "n_rollout_workers=2", "max_concurrent_rollouts=8",
+        "max_head_offpolicyness=4", "new_tokens_per_chunk=16",
+    ])
+    setup = cfg.initial_setup()
+    assert len(setup["gen_servers"]) == 2  # gen.d2 → 2 dp replicas
+    assert setup["gserver_manager"].n_servers == 2
+    assert setup["gserver_manager"].max_head_offpolicyness == 4
+    assert len(setup["rollout_workers"]) == 2
+    rw = setup["rollout_workers"][0]
+    assert rw.max_concurrent == 4  # 8 // 2 workers
+    assert rw.chunk_tokens == 16
+    assert rw.gconfig.n == 2  # group_size
+    trainer = setup["trainer"]
+    assert trainer.stream_dataset is True
+    assert set(trainer.models) == {"actor", "ref"}
+    assert set(trainer.mfcs) == {"ref_inf", "actor_inf", "actor_train"}
+    # tiny models get CPU-scale backend args
+    assert trainer.models["actor"].backend_args["length_bucket"] == 16
+    # async mode counts flattened TRAJECTORIES: 4 prompts x group_size 2
+    assert setup["master"].train_batch_size == 8
+    assert setup["gserver_manager"].train_batch_size == 8
+
+
+def test_sync_initial_setup_with_parallel_spec():
+    cfg = _tiny(PPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.disable_value=true", "allocation_mode=d2f2t2",
+        "ppo.kl_ctl=0.0",
+    ])
+    setup = cfg.initial_setup()
+    assert setup["trainer"].models["actor"].backend_args["parallel_spec"] == \
+        "d2f2t2"
+    assert set(setup["trainer"].mfcs) == {"actor_gen", "rew_inf",
+                                          "actor_train"}

@@ -1,0 +1,193 @@
+"""Checkpoint/resume e2e: kill the sync loop mid-run, resume, and continue
+to the target step count without retraining consumed samples.
+
+Parity: reference tests/system/test_buffer_recover.py + base/recover.py —
+the recover checkpoint carries optimizer state, interface state (kl ctl),
+model versions, and the dataset cursor.
+"""
+
+import json
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec
+from areal_tpu.api.dfg import (
+    MFCDef,
+    MFCInterfaceType,
+    ModelInterfaceAbstraction,
+    WeightUpdateHook,
+    build_graph,
+)
+from areal_tpu.base import name_resolve, recover
+from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+
+EXP, TRIAL = "recovertest", "t0"
+
+
+def _trainer_main(nr_root, data_path, realloc_dir):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    import areal_tpu.algorithms.reward  # noqa: F401
+    import areal_tpu.datasets.jsonl  # noqa: F401
+    from areal_tpu.algorithms.ppo import PPOHyperparameters
+    from areal_tpu.api.model import FinetuneSpec, GenerationHyperparameters
+    from areal_tpu.backend.jax_train import OptimizerConfig
+    from areal_tpu.system.trainer_worker import (
+        MFCRuntimeConfig,
+        ModelRoleConfig,
+        TrainerWorker,
+        TrainerWorkerConfig,
+    )
+
+    hp = PPOHyperparameters(
+        gen=GenerationHyperparameters(max_new_tokens=8),
+        ppo_n_minibatches=2, group_size=1, kl_ctl=0.0,
+        disable_value=True, adv_norm=True,
+    )
+    backend_args = {
+        "compute_dtype": "float32", "length_bucket": 16, "rows_bucket": 2,
+        "seqs_bucket": 4,
+        "optimizer": OptimizerConfig(lr=1e-3, lr_scheduler_type="constant",
+                                     warmup_steps_proportion=0.0),
+    }
+    cfg = TrainerWorkerConfig(
+        experiment=EXP, trial=TRIAL, handler="trainer",
+        models={
+            "actor": ModelRoleConfig(
+                init={"tiny": {"vocab_size": 258, "seed": 0}},
+                backend_args=backend_args),
+            "rw": ModelRoleConfig(init={"null": True}, backend="null"),
+        },
+        mfcs={
+            "actor_gen": MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor"),
+            "rew_inf": MFCRuntimeConfig(
+                interface="rw_math_code",
+                interface_args={"dataset_path": data_path, "group_size": 1},
+                model_name="rw"),
+            "actor_train": MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor"),
+        },
+        dataset="math_code_prompt",
+        dataset_args={"dataset_path": data_path},
+        batch_size=4,
+        ft_spec=FinetuneSpec(1, 16, 4),
+        tokenizer=MockTokenizer(),
+        realloc_dir=realloc_dir,
+    )
+    TrainerWorker(cfg).run()
+
+
+def _dfg():
+    traj_keys = ("packed_input_ids", "prompt_mask", "packed_logprobs",
+                 "seq_no_eos_mask", "task_ids", "version_start",
+                 "version_end")
+    return build_graph([
+        MFCDef(name="actor_gen", model_name="actor",
+               interface_type=MFCInterfaceType.GENERATE,
+               interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+               input_keys=("packed_prompts", "task_ids"),
+               output_keys=traj_keys, n_seqs=4,
+               mb_spec=MicroBatchSpec(max_tokens_per_mb=512)),
+        MFCDef(name="rew_inf", model_name="rw",
+               interface_type=MFCInterfaceType.INFERENCE,
+               interface_impl=ModelInterfaceAbstraction("rw_math_code"),
+               input_keys=("packed_input_ids", "prompt_mask"),
+               output_keys=("rewards",), n_seqs=4, mb_spec=MicroBatchSpec()),
+        MFCDef(name="actor_train", model_name="actor",
+               interface_type=MFCInterfaceType.TRAIN_STEP,
+               interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+               input_keys=("packed_input_ids", "prompt_mask",
+                           "packed_logprobs", "rewards", "seq_no_eos_mask"),
+               n_seqs=4, mb_spec=MicroBatchSpec(max_tokens_per_mb=512),
+               post_hooks=[WeightUpdateHook(role="actor")]),
+    ])
+
+
+def _run_master(recover_dir, benchmark_steps, do_recover):
+    from areal_tpu.system.master_worker import (
+        ExperimentSaveEvalControl,
+        MasterWorker,
+        MasterWorkerConfig,
+    )
+
+    master = MasterWorker(
+        MasterWorkerConfig(
+            experiment=EXP, trial=TRIAL, train_batch_size=4,
+            exp_ctrl=ExperimentSaveEvalControl(
+                total_train_epochs=10**6, benchmark_steps=benchmark_steps,
+                ckpt_freq_steps=1,
+            ),
+            recover_dir=recover_dir, recover=do_recover,
+        ),
+        _dfg(),
+    )
+    return master.run()
+
+
+@pytest.mark.timeout(600)
+def test_kill_and_resume_continues_run(tmp_path):
+    nr_root = str(tmp_path / "nr")
+    data_path = str(tmp_path / "math.jsonl")
+    realloc_dir = str(tmp_path / "realloc")
+    recover_dir = str(tmp_path / "recover")
+    make_math_jsonl(data_path, n=16)
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_root)
+    ctx = mp.get_context("spawn")
+
+    # ---- run 1: stops after 2 steps ("the crash") ----
+    proc = ctx.Process(target=_trainer_main,
+                       args=(nr_root, data_path, realloc_dir), daemon=True)
+    proc.start()
+    try:
+        r1 = _run_master(recover_dir, benchmark_steps=2, do_recover=False)
+        assert r1["steps"] == 2
+        proc.join(timeout=30)
+    finally:
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=10)
+
+    info = recover.load(recover_dir)
+    assert info is not None and info.last_step_info.global_step == 2
+    ckpt = recover.discover_ckpt(recover_dir)
+    assert ckpt is not None
+    with open(os.path.join(ckpt, "trainer_state.json")) as f:
+        st1 = json.load(f)
+    assert st1["meta"]["versions"]["actor"] == 2
+    assert st1["meta"]["epoch_pos"] == 8  # 2 steps x 4 prompts consumed
+
+    # ---- run 2: fresh processes, resume to step 4 total ----
+    proc = ctx.Process(target=_trainer_main,
+                       args=(nr_root, data_path, realloc_dir), daemon=True)
+    proc.start()
+    try:
+        r2 = _run_master(recover_dir, benchmark_steps=4, do_recover=True)
+        # resumed at step 2 → only 2 MORE steps ran
+        assert r2["steps"] == 4
+        assert len(r2["stats"]) == 2
+        for st in r2["stats"]:
+            assert np.isfinite(st["actor_train/actor_loss"])
+        proc.join(timeout=30)
+    finally:
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=10)
+
+    ckpt = recover.discover_ckpt(recover_dir)
+    with open(os.path.join(ckpt, "trainer_state.json")) as f:
+        st2 = json.load(f)
+    # version continued (2→4, not reset to 2) and the dataset cursor moved
+    # past the first run's samples (8→16): consumed data was NOT retrained.
+    assert st2["meta"]["versions"]["actor"] == 4
+    assert st2["meta"]["epoch_pos"] == 16
+    assert st2["meta"]["epoch"] == 0

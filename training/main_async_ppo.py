@@ -1,0 +1,18 @@
+"""Async-PPO entry point (reference ``training/main_async_ppo.py``).
+
+    python training/main_async_ppo.py --backend=tpu \
+        actor.path=/ckpts/Qwen3-1.7B dataset.path=data.jsonl \
+        allocation_mode=gen.d4+d2f2t2 dataset.train_bs_n_seqs=32 \
+        group_size=8 max_head_offpolicyness=4 max_concurrent_rollouts=16
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.experiments.async_ppo_math_exp import AsyncPPOMATHConfig  # noqa: E402
+from training._cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("async-ppo-math", AsyncPPOMATHConfig)

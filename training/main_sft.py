@@ -1,0 +1,16 @@
+"""SFT entry point (reference ``training/main_sft.py``).
+
+    python training/main_sft.py --backend=tpu \
+        model.path=/ckpts/Qwen3-1.7B dataset.path=sft.jsonl
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.experiments.sft_exp import SFTConfig  # noqa: E402
+from training._cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("sft", SFTConfig)

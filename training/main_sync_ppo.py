@@ -1,0 +1,17 @@
+"""Sync-PPO entry point (reference ``training/main_sync_ppo.py``).
+
+    python training/main_sync_ppo.py --backend=tpu \
+        actor.path=/ckpts/Qwen3-1.7B dataset.path=data.jsonl \
+        allocation_mode=d2f2t2 dataset.train_bs_n_seqs=32 group_size=8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig  # noqa: E402
+from training._cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("ppo-math", PPOMATHConfig)
