@@ -28,9 +28,13 @@ from areal_tpu.api.data import MicroBatchSpec
 from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 
 # Re-exported so experiment configs can be built from this one module, the
-# way everything in the reference imports from realhf.api.cli_args.
-from areal_tpu.backend.jax_train import OptimizerConfig  # noqa: F401
-from areal_tpu.system.master_worker import ExperimentSaveEvalControl  # noqa: F401
+# way everything in the reference imports from realhf.api.cli_args. These
+# live in the dependency-free api.train_config so that parsing configs
+# never drags in jax/optax (CPU-only children, `--help`).
+from areal_tpu.api.train_config import (  # noqa: F401
+    ExperimentSaveEvalControl,
+    OptimizerConfig,
+)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,44 @@
+"""Dependency-free leaf config dataclasses shared by the config tree.
+
+These used to live in ``backend/jax_train.py`` and
+``system/master_worker.py``, which made ``api.cli_args`` (and therefore
+every process that merely parses configs — ``--help``, CPU-only manager /
+rollout children) import jax+optax at startup (advisor r2). They are
+re-exported from their original homes for compatibility.
+
+Parity targets: reference ``cli_args.py:173`` (OptimizerConfig) and
+``cli_args.py:702`` (ExperimentSaveEvalControl).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Reference cli_args.py:173 (OptimizerConfig)."""
+
+    type: str = "adamw"
+    lr: float = 1e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    warmup_steps_proportion: float = 0.02
+    lr_scheduler_type: str = "constant"  # constant | cosine | linear
+    gradient_clipping: float = 1.0
+
+
+@dataclasses.dataclass
+class ExperimentSaveEvalControl:
+    """Reference cli_args.py:702."""
+
+    total_train_epochs: int = 1
+    benchmark_steps: Optional[int] = None  # stop after N train steps
+    save_freq_steps: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = None
+    ckpt_freq_secs: Optional[int] = None
+    eval_freq_steps: Optional[int] = None

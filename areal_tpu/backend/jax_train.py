@@ -43,21 +43,9 @@ from areal_tpu.parallel import sharding as psh
 
 logger = logging.getLogger("backend.jax")
 
-
-@dataclasses.dataclass
-class OptimizerConfig:
-    """Reference cli_args.py:173 (OptimizerConfig)."""
-
-    type: str = "adamw"
-    lr: float = 1e-5
-    weight_decay: float = 0.05
-    beta1: float = 0.9
-    beta2: float = 0.95
-    eps: float = 1e-5
-    min_lr_ratio: float = 0.0
-    warmup_steps_proportion: float = 0.02
-    lr_scheduler_type: str = "constant"  # constant | cosine | linear
-    gradient_clipping: float = 1.0
+# Canonical home is the dependency-free api.train_config; re-exported here
+# because this module historically defined it.
+from areal_tpu.api.train_config import OptimizerConfig  # noqa: E402,F401
 
 
 def build_lr_schedule(cfg: OptimizerConfig, total_steps: int):
@@ -325,13 +313,18 @@ class JaxTrainEngine(TrainableEngine):
             )
         # optax evaluated the schedule at the PRE-increment count.
         applied_lr = float(self.lr_schedule(self.opt_step_count))
-        self.opt_step_count += 1
         # ONE host round trip for all scalars (each float() would be a
         # separate device→host sync — expensive through the tunnel).
         fetched = jax.device_get({
             **stats_acc, "loss": loss_acc, "grad_norm": gnorm,
             "update_applied": applied,
         })
+        # A skipped (early-stopped) update must not advance the LR schedule:
+        # optax's internal count is an array leaf and was reverted by the
+        # gate; keep the host-side mirror in lockstep (reference
+        # abandon-minibatch semantics).
+        if bool(fetched["update_applied"]):
+            self.opt_step_count += 1
         # Engine bookkeeping keys are written AFTER the user stats and would
         # clobber same-named loss_fn stats — keep them namespaced.
         out = {k: float(v) for k, v in fetched.items()}

@@ -37,10 +37,13 @@ def transformer_flops_per_token(
     vocab_size: int,
     avg_seqlen: float,
     backward: bool = True,
+    remat: bool = False,
 ) -> float:
     """Analytic FLOPs per token (llama formula family, reference
     monitor.py:288-330): matmul terms 2·m·n·k plus the attention-score
-    quadratic term; backward ≈ 2× forward."""
+    quadratic term; backward ≈ 2× forward, or 3× forward under activation
+    rematerialization (the forward is recomputed in the backward pass —
+    reference checkpoint_activations_factor=4)."""
     d, f = hidden_dim, intermediate_dim
     attn_proj = 2 * d * (q_dim + 2 * kv_dim) + 2 * q_dim * d
     attn_score = 2 * 2 * q_dim * avg_seqlen  # QK^T and PV, causal avg ≈ L/2·2
@@ -48,15 +51,19 @@ def transformer_flops_per_token(
     per_layer = attn_proj + attn_score + mlp
     head = 2 * d * vocab_size
     fwd = n_layers * per_layer + head
-    return fwd * (3.0 if backward else 1.0)
+    if not backward:
+        return fwd
+    return fwd * (4.0 if remat else 3.0)
 
 
-def model_flops_per_token(cfg, avg_seqlen: float, backward: bool = True) -> float:
+def model_flops_per_token(
+    cfg, avg_seqlen: float, backward: bool = True, remat: bool = False
+) -> float:
     """FLOPs/token from a models.config.TransformerConfig."""
     return transformer_flops_per_token(
         cfg.n_layers, cfg.hidden_dim, cfg.q_dim, cfg.kv_dim,
         cfg.intermediate_dim, 1 if cfg.is_critic else cfg.vocab_size,
-        avg_seqlen, backward=backward,
+        avg_seqlen, backward=backward, remat=remat,
     )
 
 
@@ -66,8 +73,13 @@ class FlopsCounter:
     def __init__(self):
         self.flops = 0.0
 
-    def add_train(self, cfg, n_tokens: float, avg_seqlen: float) -> None:
-        self.flops += model_flops_per_token(cfg, avg_seqlen, True) * n_tokens
+    def add_train(
+        self, cfg, n_tokens: float, avg_seqlen: float, remat: bool = False
+    ) -> None:
+        self.flops += (
+            model_flops_per_token(cfg, avg_seqlen, True, remat=remat)
+            * n_tokens
+        )
 
     def add_inf(self, cfg, n_tokens: float, avg_seqlen: float) -> None:
         self.flops += model_flops_per_token(cfg, avg_seqlen, False) * n_tokens

@@ -36,8 +36,11 @@ from areal_tpu.api.dfg import (
     build_graph,
 )
 from areal_tpu.api.model import FinetuneSpec
+from areal_tpu.base import logging
 from areal_tpu.experiments import register_experiment
 from areal_tpu.experiments import common as C
+
+logger = logging.getLogger("experiments.ppo_math")
 
 # Keys produced by the generate MFC (trajectory contract, §2.9 of SURVEY).
 TRAJ_KEYS = (
@@ -182,6 +185,20 @@ class PPOMATHConfig(BaseExperimentConfig):
             ))
         return build_graph(mfcs)
 
+    def _dataset_size(self) -> int:
+        """Actual dataset length (JSONL line count) so epoch accounting and
+        the LR schedule's total_steps are right (advisor r2: the previous
+        10000 placeholder skewed both for any real dataset)."""
+        try:
+            with open(self.dataset.path, "rb") as f:
+                return max(1, sum(1 for line in f if line.strip()))
+        except OSError:
+            logger.warning(
+                f"cannot read dataset {self.dataset.path}; "
+                "assuming 10000 samples for schedule math"
+            )
+            return 10000
+
     def build_trainer_config(self, async_mode: bool = False):
         from areal_tpu.system.trainer_worker import (
             MFCRuntimeConfig,
@@ -192,8 +209,9 @@ class PPOMATHConfig(BaseExperimentConfig):
         alloc = C.resolve_allocation(self)
         spec = alloc.global_spec
         paths = C.experiment_paths(self)
+        dataset_size = self._dataset_size()
         steps_per_epoch = max(
-            1, 10000 // max(self.dataset.train_bs_n_seqs, 1)
+            1, dataset_size // max(self.dataset.train_bs_n_seqs, 1)
         )
         total_steps = self.exp_ctrl.total_train_epochs * steps_per_epoch
         hp = self._hp()
@@ -265,7 +283,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             batch_size=self.dataset.train_bs_n_seqs,
             ft_spec=FinetuneSpec(
                 total_train_epochs=self.exp_ctrl.total_train_epochs,
-                dataset_size=10000,
+                dataset_size=dataset_size,
                 train_batch_size=self.dataset.train_bs_n_seqs,
             ),
             tokenizer=None,  # resolved in-process by the launcher entry

@@ -22,7 +22,11 @@ import numpy as np
 from areal_tpu.api.model import GenerationHyperparameters
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import forward, init_kv_cache
-from areal_tpu.ops.sampling import sample_token
+from areal_tpu.ops.sampling import (
+    sample_token,
+    sample_token_rows,
+    sampling_from_gconfigs,
+)
 
 
 @partial(
@@ -190,23 +194,25 @@ def prefill_state(
 
 @partial(
     jax.jit,
-    static_argnames=(
-        "cfg", "gconfig", "n_tokens", "eos_token_id", "pad_token_id",
-    ),
+    static_argnames=("cfg", "n_tokens", "eos_token_id", "pad_token_id"),
     donate_argnames=("state",),
 )
-def decode_chunk(
+def decode_chunk_rows(
     params,
     cfg: TransformerConfig,
     state: Dict[str, jnp.ndarray],
     tokens_done: jnp.ndarray,  # [B] tokens generated in previous chunks
     key: jax.Array,
-    gconfig: GenerationHyperparameters,
+    sampling: Dict[str, jnp.ndarray],  # per-row arrays (ops.sampling)
     n_tokens: int,
     eos_token_id: int,
     pad_token_id: int,
 ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """Continue decoding ``n_tokens`` from a decode state.
+
+    Per-row sampling params (temperature/top_k/top_p/greedy/min_new_tokens)
+    are DYNAMIC [B] arrays: one compiled kernel serves arbitrary gconfig
+    mixes, so the server batches purely by computation shape.
 
     Returns (new_state, out) with out like generate_batch's (output_ids /
     output_logprobs / output_lens / gen_mask). Equivalent to the tail of
@@ -221,12 +227,12 @@ def decode_chunk(
         kv_k, kv_v, last_logits, cur_len, done, finished, key = carry
         key, sub = jax.random.split(key)
         logits = last_logits
-        if gconfig.min_new_tokens > 0:
-            eos_block = (done < gconfig.min_new_tokens)[:, None] & (
-                jnp.arange(V) == eos_token_id
-            )[None, :]
-            logits = jnp.where(eos_block, -1e30, logits)
-        token, logprob = sample_token(logits, sub, gconfig)
+        # Forbid EOS while a row is under its min_new_tokens budget.
+        eos_block = (done < sampling["min_new_tokens"])[:, None] & (
+            jnp.arange(V) == eos_token_id
+        )[None, :]
+        logits = jnp.where(eos_block, -1e30, logits)
+        token, logprob = sample_token_rows(logits, sub, sampling)
         token = jnp.where(finished, pad_token_id, token)
         logprob = jnp.where(finished, 0.0, logprob)
 
@@ -269,6 +275,27 @@ def decode_chunk(
         "gen_mask": gen_mask,
     }
     return new_state, out
+
+
+def decode_chunk(
+    params,
+    cfg: TransformerConfig,
+    state: Dict[str, jnp.ndarray],
+    tokens_done: jnp.ndarray,
+    key: jax.Array,
+    gconfig: GenerationHyperparameters,
+    n_tokens: int,
+    eos_token_id: int,
+    pad_token_id: int,
+) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """Uniform-gconfig convenience wrapper over decode_chunk_rows."""
+    B = int(state["cur_len"].shape[0])
+    sampling = sampling_from_gconfigs([gconfig] * B)
+    return decode_chunk_rows(
+        params, cfg, state, tokens_done, key, sampling,
+        n_tokens=n_tokens, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id,
+    )
 
 
 def grow_state(state: Dict[str, jnp.ndarray], new_S: int) -> Dict[str, jnp.ndarray]:

@@ -62,3 +62,76 @@ def sample_token(
         tokens = jax.random.categorical(key, warped, axis=-1)
     chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
     return tokens.astype(jnp.int32), chosen
+
+
+# ---------------------------------------------------------------------------
+# Per-row sampling — temperature/top-k/top-p/greedy as [B] ARRAYS, so one
+# compiled decode kernel serves a batch of requests with different sampling
+# hyperparameters (the server batches by computation shape only; mixed
+# temperatures no longer serialize or recompile).
+# ---------------------------------------------------------------------------
+
+
+def sampling_from_gconfigs(gconfigs) -> dict:
+    """Per-row sampling-parameter arrays from a list of gconfigs (one per
+    batch row). The dict is a pytree of [B] arrays — a dynamic jit arg."""
+    import numpy as np
+
+    return {
+        "temperature": np.asarray(
+            [g.temperature for g in gconfigs], np.float32
+        ),
+        "top_k": np.asarray([g.top_k for g in gconfigs], np.int32),
+        "top_p": np.asarray([g.top_p for g in gconfigs], np.float32),
+        "greedy": np.asarray([g.greedy for g in gconfigs], bool),
+        "min_new_tokens": np.asarray(
+            [g.min_new_tokens for g in gconfigs], np.int32
+        ),
+    }
+
+
+def warp_logits_rows(
+    logits: jnp.ndarray,  # [B, V]
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int; <=0 disables
+    top_p: jnp.ndarray,  # [B] float; >=1 disables
+) -> jnp.ndarray:
+    """Row-wise equivalent of sequential apply_temperature → top_k → top_p.
+
+    One sort serves both filters: top-k keeps the first k sorted slots;
+    top-p renormalizes over those and keeps the nucleus prefix."""
+    V = logits.shape[-1]
+    logits = logits / jnp.maximum(temperature[:, None], 1e-6)
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.arange(V)[None, :]
+    keep_k = (top_k[:, None] <= 0) | (idx < top_k[:, None])
+    probs = jax.nn.softmax(
+        jnp.where(keep_k, sorted_desc, _NEG_INF), axis=-1
+    )
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep while exclusive-cumulative < p (always keeps top-1), within top-k.
+    # p>=1 disables nucleus filtering outright (cum can round to exactly 1.0
+    # on near-zero tail probs, which would otherwise clip them spuriously).
+    keep = (
+        ((cum - probs) < top_p[:, None]) | (top_p[:, None] >= 1.0)
+    ) & keep_k
+    n_keep = jnp.maximum(keep.sum(axis=-1, keepdims=True), 1)
+    kth = jnp.take_along_axis(sorted_desc, n_keep - 1, axis=-1)
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def sample_token_rows(
+    logits: jnp.ndarray,  # [B, V] raw logits
+    key: jax.Array,
+    sampling: dict,  # per-row arrays from sampling_from_gconfigs
+):
+    """Row-wise sample_token: each row uses its own sampling params."""
+    warped = warp_logits_rows(
+        logits, sampling["temperature"], sampling["top_k"], sampling["top_p"]
+    )
+    logp = jax.nn.log_softmax(warped, axis=-1)
+    sampled = jax.random.categorical(key, warped, axis=-1)
+    greedy_tok = jnp.argmax(warped, axis=-1)
+    tokens = jnp.where(sampling["greedy"], greedy_tok, sampled)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens.astype(jnp.int32), chosen

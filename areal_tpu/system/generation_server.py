@@ -56,6 +56,11 @@ class GenerationServerConfig:
     # whole prefix (the reference's SGLang radix-cache role). 0 disables.
     kv_slots: int = 256
     kv_bucket: int = 256  # KV capacity granularity (slots)
+    # Hard budget on retained KV BYTES (not just state count): per-request
+    # KV grows with sequence length, so count alone can exhaust HBM long
+    # before kv_slots states (advisor r2, medium). LRU-evicted states simply
+    # re-prefill on their next chunk.
+    kv_bytes_budget: int = 4 << 30
 
 
 class _Pending:
@@ -75,13 +80,14 @@ class _Pending:
 class _ReqState:
     """Server-resident decode state of one in-flight chunked request."""
 
-    __slots__ = ("state", "cur_len", "version", "last_used")
+    __slots__ = ("state", "cur_len", "version", "last_used", "nbytes")
 
     def __init__(self, state, cur_len: int, version: int):
         self.state = state  # single-row decode state (models.generate)
         self.cur_len = cur_len
         self.version = version
         self.last_used = time.monotonic()
+        self.nbytes = state["kv_k"].nbytes + state["kv_v"].nbytes
 
 
 class GenerationServer:
@@ -109,6 +115,7 @@ class GenerationServer:
         self._t_start = time.monotonic()
         self._runner_task = None
         self._states: Dict[str, _ReqState] = {}
+        self._last_update_latency = 0.0
 
     # ---------------- decode core ----------------
 
@@ -122,11 +129,10 @@ class GenerationServer:
         # sampled under the old weights must be tagged with the version
         # that actually produced them (decoupled-loss bookkeeping).
         params, version = self.params, self.version
-        # _runner groups the batch by identical gconfig, which includes the
-        # requested chunk length — so this is uniform across the batch (and
-        # decode_chunk recompiles only per distinct final-chunk size).
+        # Sampling params are per-ROW dynamic arrays (ops.sampling), so a
+        # batch may freely mix gconfigs; only the chunk length (static) is
+        # shared, and decode recompiles only per distinct final-chunk size.
         chunk = min(cfg.chunk_tokens, max(p.max_tokens for p in batch))
-        gconfig = batch[0].gconfig
 
         # Split: requests whose decode state survived (same version, prefix
         # length matches) continue from their KV; the rest prefill.
@@ -176,8 +182,11 @@ class GenerationServer:
             stacked = genmod.stack_states([row_states[id(p)] for p in group])
             done = jnp.asarray([p.tokens_done for p in group], jnp.int32)
             self._key, sub = jax.random.split(self._key)
-            new_state, out = genmod.decode_chunk(
-                params, self.model_cfg, stacked, done, sub, gconfig,
+            from areal_tpu.ops.sampling import sampling_from_gconfigs
+
+            new_state, out = genmod.decode_chunk_rows(
+                params, self.model_cfg, stacked, done, sub,
+                sampling_from_gconfigs([p.gconfig for p in group]),
                 n_tokens=chunk,
                 eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
             )
@@ -225,8 +234,12 @@ class GenerationServer:
         if cap <= 0:
             self._states.clear()
             return
-        while len(self._states) > cap:
+        total_bytes = sum(s.nbytes for s in self._states.values())
+        while len(self._states) > cap or (
+            total_bytes > self.cfg.kv_bytes_budget and self._states
+        ):
             oldest = min(self._states, key=lambda r: self._states[r].last_used)
+            total_bytes -= self._states[oldest].nbytes
             del self._states[oldest]
 
     async def _runner(self):
@@ -235,19 +248,11 @@ class GenerationServer:
             first: _Pending = await self._queue.get()
             batch = [first]
             await asyncio.sleep(cfg.batch_window_ms / 1000)
-            # Drain only requests with the SAME sampling params as the
-            # head of the batch — one generate_batch call applies one
-            # gconfig, and mixed-temperature clients must not silently get
-            # the first request's params. Mismatches go back in the queue.
-            deferred = []
+            # Drain in FIFO order up to max_batch_size. Sampling params are
+            # per-row vectors inside the decode kernel, so mixed gconfigs
+            # batch together — no deferral, no starvation.
             while len(batch) < cfg.max_batch_size and not self._queue.empty():
-                p = self._queue.get_nowait()
-                if p.gconfig == first.gconfig:
-                    batch.append(p)
-                else:
-                    deferred.append(p)
-            for p in deferred:
-                self._queue.put_nowait(p)
+                batch.append(self._queue.get_nowait())
             try:
                 results = await asyncio.to_thread(self._decode_batch, batch)
                 for p, r in zip(batch, results):
@@ -275,22 +280,30 @@ class GenerationServer:
         ))
         return web.json_response(await fut)
 
-    async def handle_update_weights(self, request):
+    def _load_and_put_weights(self, path: str):
+        """Host-side checkpoint read + device upload. Runs in a worker
+        thread — the event loop (and /generate batching) never blocks on
+        disk or transfer; only the final reference swap happens on-loop."""
         import jax
 
         from areal_tpu.models import hf as hfmod
 
-        d = await request.json()
-        t0 = time.monotonic()
-        cfg2, params = hfmod.load_hf_checkpoint(d["path"])
+        _, params = hfmod.load_hf_checkpoint(path)
         # Preserve the existing per-leaf device placement/sharding.
-        new = jax.tree.map(
+        return jax.tree.map(
             lambda old, npv: jax.device_put(
                 np.asarray(npv, dtype=old.dtype), old.sharding
             ),
             self.params,
             params,
         )
+
+    async def handle_update_weights(self, request):
+        d = await request.json()
+        t0 = time.monotonic()
+        new = await asyncio.to_thread(self._load_and_put_weights, d["path"])
+        # Atomic (params, version) swap: in-flight _decode_batch threads
+        # captured the old pair and tag their tokens with the old version.
         self.params = new
         self.version = int(d.get("version", self.version + 1))
         # KV computed under the old weights is stale — continuations after
@@ -298,6 +311,7 @@ class GenerationServer:
         # cache on update_weights_from_disk).
         self._states.clear()
         dt = time.monotonic() - t0
+        self._last_update_latency = dt
         logger.info(f"weights updated to v{self.version} in {dt:.2f}s")
         from aiohttp import web
 
@@ -318,7 +332,9 @@ class GenerationServer:
             "prefill_tokens": self._prefill_tokens,
             "tokens_per_sec": self._tokens_out / dt,
             "kv_states": len(self._states),
+            "kv_bytes": sum(s.nbytes for s in self._states.values()),
             "version": self.version,
+            "last_weight_update_latency_s": self._last_update_latency,
         })
 
     def build_app(self):

@@ -163,10 +163,15 @@ class GserverManager:
         from aiohttp import web
 
         d = await request.json()
+        # n_samples must mirror what /allocate_rollout booked for this
+        # rollout (group_size), independent of acceptance; n_accepted is how
+        # many of those samples were actually pushed to the trainer.
         n = int(d.get("n_samples", 1))
         self.running_rollouts = max(0, self.running_rollouts - n)
-        if d.get("accepted"):
-            self.accepted_rollouts += n
+        n_accepted = int(
+            d.get("n_accepted", n if d.get("accepted") else 0)
+        )
+        self.accepted_rollouts += n_accepted
         return web.json_response({"ok": True})
 
     async def handle_get_model_version(self, request):

@@ -38,16 +38,9 @@ from areal_tpu.system.streams import MasterRequestStream, Payload
 logger = logging.getLogger("system.master")
 
 
-@dataclasses.dataclass
-class ExperimentSaveEvalControl:
-    """Reference cli_args.py:702."""
-
-    total_train_epochs: int = 1
-    benchmark_steps: Optional[int] = None  # stop after N train steps
-    save_freq_steps: Optional[int] = None
-    ckpt_freq_steps: Optional[int] = None
-    ckpt_freq_secs: Optional[int] = None
-    eval_freq_steps: Optional[int] = None
+# Canonical home is the dependency-free api.train_config; re-exported here
+# because this module historically defined it.
+from areal_tpu.api.train_config import ExperimentSaveEvalControl  # noqa: E402,F401
 
 
 @dataclasses.dataclass
@@ -186,7 +179,9 @@ class MasterWorker:
             is_critic = info["is_critic"]
 
         if node.interface_type == MFCInterfaceType.TRAIN_STEP:
-            self._flops.add_train(_C, n_tokens, avg)
+            self._flops.add_train(
+                _C, n_tokens, avg, remat=info.get("remat", False)
+            )
         else:
             self._flops.add_inf(_C, n_tokens, avg)
 

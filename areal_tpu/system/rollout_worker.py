@@ -80,8 +80,14 @@ class RolloutWorker:
 
     async def _rollout_one(self, rec, client, pusher, mgr_url, session):
         cfg = self.cfg
-        # quota / staleness gate
-        async with session.post(f"{mgr_url}/allocate_rollout", json={}) as r:
+        # quota / staleness gate — allocate in SAMPLE units: one prompt
+        # produces group_size samples, and the manager's is_staled /
+        # max_concurrent_rollouts bookkeeping counts samples (reference
+        # gserver_manager.py:351 compares against train_batch_size samples).
+        async with session.post(
+            f"{mgr_url}/allocate_rollout",
+            json={"n_samples": cfg.group_size},
+        ) as r:
             alloc = await r.json()
         if not alloc.get("allowed"):
             await asyncio.sleep(0.5)
@@ -115,9 +121,16 @@ class RolloutWorker:
             accepted = len(final)
             self._pushed += accepted
         finally:
+            # Release EXACTLY what was allocated (group_size samples) so the
+            # manager's running_rollouts never drifts; acceptance only gates
+            # how many samples count as headed for the trainer (n_accepted).
             await session.post(
                 f"{mgr_url}/finish_rollout",
-                json={"accepted": accepted > 0, "n_samples": accepted},
+                json={
+                    "accepted": accepted > 0,
+                    "n_samples": cfg.group_size,
+                    "n_accepted": accepted,
+                },
             )
         self._done += 1
         return True

@@ -328,6 +328,10 @@ class TrainerWorker:
                 "intermediate_dim": cfg.intermediate_dim,
                 "vocab_size": cfg.vocab_size, "is_critic": cfg.is_critic,
                 "n_params": param_count(cfg),
+                # Remat recomputes activations in backward → 4× forward
+                # FLOPs instead of 3× (reference checkpoint_activations
+                # factor); the master's MFU math needs to know.
+                "remat": bool(getattr(engine, "remat", False)),
             }
         return info
 
