@@ -29,6 +29,7 @@ GRPO group statistics use the metadata instead.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional
 
 import jax
@@ -192,10 +193,11 @@ def compute_advantages_and_returns(
     # changes nothing the actor loss reads). v_prev at the first action slot
     # still holds the last-prompt-slot value — shift BEFORE restricting.
     act_seg = np.where(amask, g["segment_ids"], 0)
-    adv, ret = F.gae_grid(
+    # One jitted dispatch: eager gae_grid is ~20 separate device ops, which
+    # costs >1.5s/step through a remote-device tunnel (measured r3).
+    adv, ret = _gae_grid_jit(
         jnp.asarray(rewards), jnp.asarray(v_prev), jnp.asarray(act_seg),
-        bootstrap=jnp.asarray(boot),
-        gamma=hp.discount, lam=hp.gae_lambda,
+        jnp.asarray(boot), hp.discount, hp.gae_lambda,
     )
     adv, ret = np.asarray(adv), np.asarray(ret)
     out = {}
@@ -205,6 +207,75 @@ def compute_advantages_and_returns(
         ).astype(np.float32)
     out["_mean_kl"] = float(kl.sum() / max(amask.sum(), 1))
     return out
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _gae_grid_jit(rewards, v_prev, act_seg, boot, gamma, lam):
+    return F.gae_grid(
+        rewards, v_prev, act_seg, bootstrap=boot, gamma=gamma, lam=lam
+    )
+
+
+def make_advantage_prep(hp: PPOHyperparameters):
+    """Device-side advantage pipeline over an uploaded UniformBatch: the
+    jnp mirror of compute_advantages_and_returns + normalize_advantages,
+    fused into ONE dispatch with no host round trip (grids stay on device
+    for the grad steps). Global advantage whitening only — group_adv_norm
+    keeps the host path."""
+
+    def prep(grids, seq, R, scalars):
+        seg = grids["segment_ids"]
+        amask = F.action_token_mask(seg, grids["prompt_mask"])
+        amf = amask.astype(jnp.float32)
+        behav = grids["packed_logprobs"]
+        ref = grids.get("packed_ref_logprobs", jnp.zeros_like(behav))
+        kl = (behav - ref) * amf
+        values = grids.get("values", jnp.zeros_like(behav)) * (seg > 0)
+
+        score = seq["rewards"].astype(jnp.float32)  # [n_mbs, S]
+        no_eos = (
+            seq["seq_no_eos_mask"] > 0
+            if "seq_no_eos_mask" in seq
+            else jnp.zeros_like(score, bool)
+        )
+        if hp.mask_no_eos_with_zero:
+            score = jnp.where(no_eos, 0.0, score)
+        tok_score = jnp.clip(
+            (score - hp.reward_output_bias) * hp.reward_output_scaling,
+            -hp.max_reward_clip, hp.max_reward_clip,
+        )
+        # Flatten [n_mbs, S] sequence coordinates into the [n_mbs*R, L] grid.
+        n_mbs = seq["seq_rows"].shape[0]
+        mb_off = (jnp.arange(n_mbs)[:, None] * R)
+        rows_f = (seq["seq_rows"] + mb_off).reshape(-1)
+        lasts_f = seq["seq_last_cols"].reshape(-1)
+        valid_f = seq["seq_mask"].reshape(-1).astype(jnp.float32)
+
+        kl_rw = -scalars["kl_coef"] * kl * amf
+        rewards_grid = kl_rw.at[rows_f, lasts_f].add(
+            tok_score.reshape(-1) * valid_f
+        )
+        v_prev = F.shift_right_in_doc(values, seg)
+        boot = jnp.zeros_like(values).at[rows_f, lasts_f].add(
+            values[rows_f, lasts_f]
+            * no_eos.reshape(-1).astype(jnp.float32) * valid_f
+        )
+        act_seg = jnp.where(amask, seg, 0)
+        adv, ret = F.gae_grid(
+            rewards_grid, v_prev, act_seg, bootstrap=boot,
+            gamma=hp.discount, lam=hp.gae_lambda,
+        )
+        out_scalars = {
+            "_mean_kl": kl.sum() / jnp.maximum(amf.sum(), 1.0),
+        }
+        if hp.adv_norm:
+            adv = F.masked_normalization(adv, amask)
+        return (
+            {"advantages": adv, "returns": ret, "kl_rewards": kl_rw},
+            out_scalars,
+        )
+
+    return prep
 
 
 def _group_keys(sample: SequenceSample) -> List[str]:
@@ -282,6 +353,7 @@ class PPOActorInterface(ModelInterface):
             return loss, stats
 
         self._loss_fn = actor_loss_fn
+        self._prep_fn = make_advantage_prep(self.hp)
 
     # ---- MFC methods ----
 
@@ -327,48 +399,97 @@ class PPOActorInterface(ModelInterface):
     ) -> Dict[str, float]:
         hp = self.hp
         engine = model.module
-        extra = compute_advantages_and_returns(data, hp, self.kl_ctl.value)
-        mean_kl = extra.pop("_mean_kl")
-        data = attach_keys(data, extra)
-        if hp.adv_norm or hp.group_adv_norm:
-            normalize_advantages(data, hp)
-
-        # PPO minibatch loop (reference ppo_interface.py:698-760): split the
-        # batch into ppo_n_minibatches, one optimizer step each.
-        minibatches, _ = data.split(k=min(hp.ppo_n_minibatches, data.bs))
+        skip_rule = (
+            "importance_weight_sum", "n_action_tokens",
+            hp.early_stop_imp_ratio or 0.0,
+        )
         agg: Dict[str, float] = {}
         n_steps = 0
-        for mb_sample in minibatches:
-            if mb_sample.bs == 0:
-                continue
-            # Early-stop semantics (reference ppo_interface.py:735-760): the
-            # importance ratio is checked BEFORE the optimizer step — the
-            # engine skips the update on device when the ratio exceeds the
-            # cap, and we stop the remaining minibatches.
-            stats = engine.train_batch(
-                mb_sample, mb_spec, self._loss_fn,
-                _action_token_weight,
-                version_steps=model.version.global_step,
-                skip_update_rule=(
-                    "importance_weight_sum", "n_action_tokens",
-                    hp.early_stop_imp_ratio or 0.0,
-                ),
+        mean_kl = 0.0
+
+        if not hp.group_adv_norm and hasattr(engine, "upload_uniform"):
+            # Fast path: ONE h2d upload of the whole batch, GAE + advantage
+            # whitening fused on device (make_advantage_prep), micro-batches
+            # sliced on device by index — per step this is n_mb dispatches,
+            # one apply and ONE host sync per PPO minibatch (critical
+            # through a remote-device transport; also the best pipelining
+            # locally).
+            ub = engine.upload_uniform(data, mb_spec)
+            scalars = engine.run_prep(
+                ub, self._prep_fn, self._prep_fn,
+                scalars={"kl_coef": self.kl_ctl.value},
             )
-            n_steps += 1
-            for k, v in stats.items():
-                agg[k] = agg.get(k, 0.0) + float(v)
-            if stats.get("update_applied", 1.0) == 0.0:
-                n = max(stats.get("n_action_tokens", 1.0), 1.0)
-                imp = stats.get("importance_weight_sum", 0.0) / n
-                logger.warning(
-                    f"early-stopping PPO minibatches: importance ratio "
-                    f"{imp:.2f} > {hp.early_stop_imp_ratio} (update skipped)"
+            k = min(hp.ppo_n_minibatches, ub.n_mbs)
+            # Contiguous micro-batch groups, one optimizer step each
+            # (reference ppo_interface.py:698-760 minibatch loop).
+            bounds = np.linspace(0, ub.n_mbs, k + 1).astype(int)
+            groups = [
+                list(range(bounds[i], bounds[i + 1]))
+                for i in range(k) if bounds[i + 1] > bounds[i]
+            ]
+            for gi, g in enumerate(groups):
+                stats = engine.train_uniform(
+                    ub, self._loss_fn, _action_token_weight, mb_indices=g,
+                    skip_update_rule=skip_rule,
+                    extra_fetch={"_mean_kl": scalars["_mean_kl"]},
                 )
-                break
+                mean_kl = stats.pop("_mean_kl")
+                n_steps += 1
+                for key, v in stats.items():
+                    agg[key] = agg.get(key, 0.0) + float(v)
+                if stats.get("update_applied", 1.0) == 0.0:
+                    n = max(stats.get("n_action_tokens", 1.0), 1.0)
+                    imp = stats.get("importance_weight_sum", 0.0) / n
+                    logger.warning(
+                        f"early-stopping PPO minibatches: importance ratio "
+                        f"{imp:.2f} > {hp.early_stop_imp_ratio} "
+                        "(update skipped)"
+                    )
+                    break
+        else:
+            extra = compute_advantages_and_returns(data, hp, self.kl_ctl.value)
+            mean_kl = extra.pop("_mean_kl")
+            data = attach_keys(data, extra)
+            if hp.adv_norm or hp.group_adv_norm:
+                normalize_advantages(data, hp)
+
+            # PPO minibatch loop (reference ppo_interface.py:698-760): split
+            # the batch into ppo_n_minibatches, one optimizer step each.
+            minibatches, _ = data.split(k=min(hp.ppo_n_minibatches, data.bs))
+            for mb_sample in minibatches:
+                if mb_sample.bs == 0:
+                    continue
+                # Early-stop semantics (reference ppo_interface.py:735-760):
+                # the importance ratio is checked BEFORE the optimizer step —
+                # the engine skips the update on device when the ratio
+                # exceeds the cap, and we stop the remaining minibatches.
+                stats = engine.train_batch(
+                    mb_sample, mb_spec, self._loss_fn,
+                    _action_token_weight,
+                    version_steps=model.version.global_step,
+                    skip_update_rule=skip_rule,
+                )
+                n_steps += 1
+                for k, v in stats.items():
+                    agg[k] = agg.get(k, 0.0) + float(v)
+                if stats.get("update_applied", 1.0) == 0.0:
+                    n = max(stats.get("n_action_tokens", 1.0), 1.0)
+                    imp = stats.get("importance_weight_sum", 0.0) / n
+                    logger.warning(
+                        f"early-stopping PPO minibatches: importance ratio "
+                        f"{imp:.2f} > {hp.early_stop_imp_ratio} "
+                        "(update skipped)"
+                    )
+                    break
         self.kl_ctl.update(mean_kl, n_steps=1)
         model.inc_version()
         n = max(agg.get("n_action_tokens", 1.0), 1.0)
+        moe_stats = {
+            k: v / max(n_steps, 1) for k, v in agg.items()
+            if k.startswith("moe_")
+        }
         return {
+            **moe_stats,
             "actor_loss": agg.get("loss", 0.0),
             "importance_weight": agg.get("importance_weight_sum", 0.0) / n,
             "clip_ratio": agg.get("clip_ratio_sum", 0.0) / n,

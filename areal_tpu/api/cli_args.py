@@ -193,6 +193,13 @@ class BaseExperimentConfig:
     cache_clear_freq: Optional[int] = 10
     # Test-only: use the deterministic mock tokenizer instead of HF.
     mock_tokenizer: bool = False
+    # Multi-host trainer: one SPMD process per host via jax.distributed
+    # (reference global_comm.py:48). >1 makes the launcher spawn that many
+    # trainer processes; with trainer_dist_devices_per_proc they run on the
+    # CPU platform with that many virtual devices each (multi-process CPU
+    # testing, SURVEY §4).
+    trainer_dist_procs: int = 1
+    trainer_dist_devices_per_proc: Optional[int] = None
 
     def resolve_trial_name(self) -> str:
         if not self.trial_name:

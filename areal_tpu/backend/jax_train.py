@@ -93,6 +93,26 @@ def build_optimizer(
 LossFn = Callable[[jnp.ndarray, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]]
 
 
+@dataclasses.dataclass
+class UniformBatch:
+    """A whole batch resident on device as one [n_mbs·R, L] grid set.
+
+    ``grids``: per-token keys (+ prep outputs); ``seq``: [n_mbs, S] stacked
+    per-micro-batch sequence arrays (grid coordinates, masks, scalar keys).
+    Host-side layouts stay in ``mbs`` for weights/scatter-back."""
+
+    mbs: List[mbu.MicroBatch]
+    R: int
+    L: int
+    S: int
+    grids: Dict[str, jnp.ndarray]
+    seq: Dict[str, jnp.ndarray]
+
+    @property
+    def n_mbs(self) -> int:
+        return len(self.mbs)
+
+
 class JaxTrainEngine(TrainableEngine):
     """Owns (params, opt_state) on an optional mesh and the jitted steps."""
 
@@ -153,8 +173,10 @@ class JaxTrainEngine(TrainableEngine):
 
         return jax.tree.map(c, params)
 
-    def _model_forward(self, params, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-        out, _ = transformer.forward(
+    def _model_forward(
+        self, params, batch: Dict[str, jnp.ndarray], with_aux: bool = False
+    ):
+        out, _, aux = transformer.forward(
             self._cast(params),
             self.cfg,
             batch["tokens"],
@@ -163,28 +185,68 @@ class JaxTrainEngine(TrainableEngine):
             attn_impl=self.attn_impl,
             remat=self.remat,
             return_kv=False,
+            return_aux=True,
         )
         # Critic values [B, L] are cheap in f32; lm logits [B, L, V] stay in
         # the compute dtype — loss fns upcast per-element inside fused
         # reductions (see ppo_functional.gather_logprobs).
-        return out.astype(jnp.float32) if self.cfg.is_critic else out
+        out = out.astype(jnp.float32) if self.cfg.is_critic else out
+        return (out, aux) if with_aux else out
 
-    def _get_grad_fn(self, loss_fn: LossFn) -> Callable:
-        # Keyed by the function OBJECT (keeps it alive): an id() key could
-        # be reused by a new closure after GC and silently run stale code.
-        if loss_fn not in self._grad_fns:
+    def _get_grad_fn(self, loss_fn: LossFn, with_carry: bool) -> Callable:
+        """Fused grad + accumulate step, one dispatch per micro-batch.
 
-            def f(params, batch, denom):
+        ``with_carry``: the (loss, stats, grads) accumulators from the
+        previous micro-batch ride through the jit (donated) and the adds
+        happen on device — eager tree-map adds between dispatches cost
+        ~300ms/step through a remote-device tunnel (measured r3).
+
+        ``scale`` multiplies this micro-batch's loss/grads ("mb" normalize
+        scope passes 1/n_mbs); ``aux_scale`` multiplies the MoE balancing
+        loss so its total contribution over the whole batch equals one
+        aux_total regardless of the micro-batch count.
+
+        Keyed by the function OBJECT (keeps it alive): an id() key could
+        be reused by a new closure after GC and silently run stale code.
+        """
+        key = (loss_fn, with_carry)
+        if key not in self._grad_fns:
+
+            def f(params, batch, denom, scale, aux_scale, carry=None):
                 def lf(p):
-                    out = self._model_forward(p, batch)
+                    out, aux = self._model_forward(p, batch, with_aux=True)
                     loss_sum, stats = loss_fn(out, batch)
-                    return loss_sum / jnp.maximum(denom, 1.0), stats
+                    loss = loss_sum / jnp.maximum(denom, 1.0)
+                    if aux:
+                        # MoE balancing losses (reference utils/moe.py aux
+                        # tracker), surfaced under a reserved "moe_" prefix
+                        # (train_batch divides the stats by the mb count).
+                        loss = loss + aux["aux_total"] * aux_scale
+                        stats = dict(stats, **{
+                            f"moe_{k}": v for k, v in aux.items()
+                        })
+                    return loss, stats
 
                 (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                loss = loss * scale
+                # Cast the scale into each leaf's dtype: a f32 scalar would
+                # silently promote bf16 grads to f32 (2x grad + carry HBM).
+                grads = jax.tree.map(
+                    lambda g: g * scale.astype(g.dtype), grads
+                )
+                if carry is not None:
+                    c_loss, c_stats, c_grads = carry
+                    loss = loss + c_loss
+                    stats = {
+                        k: stats[k] + c_stats[k] if k in c_stats else stats[k]
+                        for k in stats
+                    }
+                    grads = jax.tree.map(jnp.add, grads, c_grads)
                 return loss, stats, grads
 
-            self._grad_fns[loss_fn] = jax.jit(f)
-        return self._grad_fns[loss_fn]
+            donate = (5,) if with_carry else ()
+            self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
+        return self._grad_fns[key]
 
     def _get_apply_fn(self, skip_rule) -> Callable:
         """Optimizer update with donated buffers and an optional on-device
@@ -229,6 +291,192 @@ class JaxTrainEngine(TrainableEngine):
 
         self._grad_fns[key] = jax.jit(f, donate_argnums=(0, 1, 2))
         return self._grad_fns[key]
+
+    # -------------- upload-once uniform batches --------------
+    #
+    # Through a remote-device transport (and on any host, as a pipelining
+    # win) per-micro-batch h2d transfers are the enemy: a PPO step was
+    # spending more wall clock on ~70 small transfers + eager dispatches
+    # than on compute (measured r3: 102ms RTT, ~6.5ms/dispatch). The
+    # uniform packer (backend/microbatch.py) makes every micro-batch the
+    # same [R, L] shape, so the WHOLE batch uploads once as [n_mbs*R, L]
+    # grids and each grad step slices its rows on device by a traced index.
+
+    def upload_uniform(
+        self, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> "UniformBatch":
+        mbs = mbu.split_into_microbatches(
+            input_, mb_spec, length_bucket=self.length_bucket,
+            rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+        )
+        R, L = mbs[0].layout.shape
+        S = max(len(mb.seq_mask) for mb in mbs)
+        S = mbu.packing.round_up(S, self.seqs_bucket)
+        grids: Dict[str, jnp.ndarray] = {}
+        for k in mbs[0].grids:
+            grids[k] = jnp.asarray(
+                np.concatenate([mb.grids[k] for mb in mbs], axis=0)
+            )
+        seq: Dict[str, jnp.ndarray] = {}
+
+        def pad_stack(key, getter, dtype=None):
+            rows = []
+            for mb in mbs:
+                v = np.asarray(getter(mb))
+                pad = np.zeros((S,) + v.shape[1:], v.dtype)
+                pad[: len(v)] = v
+                rows.append(pad)
+            seq[key] = jnp.asarray(np.stack(rows))
+
+        pad_stack("seq_rows", lambda mb: mb.seq_rows)
+        pad_stack("seq_first_cols", lambda mb: mb.seq_first_cols)
+        pad_stack("seq_last_cols", lambda mb: mb.seq_last_cols)
+        pad_stack("seq_mask", lambda mb: mb.seq_mask)
+        for k in mbs[0].scalars:
+            pad_stack(k, lambda mb, k=k: mb.scalars[k])
+        return UniformBatch(mbs=mbs, R=R, L=L, S=S, grids=grids, seq=seq)
+
+    def run_prep(
+        self,
+        ub: "UniformBatch",
+        prep_fn: Callable,
+        prep_key: object,
+        scalars: Optional[Dict[str, float]] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Run a jitted full-batch preprocessing step on device:
+        ``prep_fn(grids, seq, R, scalars) -> (extra_grids, out_scalars)``.
+        The extra grids are merged into ``ub.grids`` (available to loss
+        fns); the returned scalars stay on device for the end-of-step fetch.
+        ``scalars`` are dynamic device args (e.g. an adaptive KL coef) so
+        their drift never retraces."""
+        key = ("prep", prep_key, ub.n_mbs, ub.R)
+        if key not in self._grad_fns:
+            self._grad_fns[key] = jax.jit(
+                lambda grids, seq, sc: prep_fn(grids, seq, ub.R, sc)
+            )
+        sc = {
+            k: jnp.asarray(v, jnp.float32) for k, v in (scalars or {}).items()
+        }
+        with self._mesh_ctx():
+            extra, out_scalars = self._grad_fns[key](ub.grids, ub.seq, sc)
+        ub.grids.update(extra)
+        return out_scalars
+
+    def _get_sliced_grad_fn(
+        self, loss_fn: LossFn, with_carry: bool, R: int
+    ) -> Callable:
+        """Like _get_grad_fn but takes the FULL uploaded batch and a traced
+        micro-batch index; slices its rows/seq-entries on device. ``R`` (rows
+        per micro-batch) is part of the cache key: two packings can share the
+        total grid shape while slicing differently."""
+        key = (loss_fn, with_carry, "sliced", R)
+        if key not in self._grad_fns:
+
+            def f(params, grids, seq, mb_idx, denom, scale, aux_scale,
+                  carry=None):
+                batch = {
+                    k: jax.lax.dynamic_slice_in_dim(g, mb_idx * R, R, 0)
+                    for k, g in grids.items()
+                }
+                for k, v in seq.items():
+                    batch[k] = jax.lax.dynamic_index_in_dim(
+                        v, mb_idx, 0, keepdims=False
+                    )
+
+                def lf(p):
+                    out, aux = self._model_forward(p, batch, with_aux=True)
+                    loss_sum, stats = loss_fn(out, batch)
+                    loss = loss_sum / jnp.maximum(denom, 1.0)
+                    if aux:
+                        loss = loss + aux["aux_total"] * aux_scale
+                        stats = dict(stats, **{
+                            f"moe_{k}": v for k, v in aux.items()
+                        })
+                    return loss, stats
+
+                (loss, stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                loss = loss * scale
+                grads = jax.tree.map(
+                    lambda g: g * scale.astype(g.dtype), grads
+                )
+                if carry is not None:
+                    c_loss, c_stats, c_grads = carry
+                    loss = loss + c_loss
+                    stats = {
+                        k: stats[k] + c_stats[k] if k in c_stats else stats[k]
+                        for k in stats
+                    }
+                    grads = jax.tree.map(jnp.add, grads, c_grads)
+                return loss, stats, grads
+
+            donate = (7,) if with_carry else ()
+            self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
+        return self._grad_fns[key]
+
+    def train_uniform(
+        self,
+        ub: "UniformBatch",
+        loss_fn: LossFn,
+        loss_weight_fn: Callable[[mbu.MicroBatch], float],
+        mb_indices: Optional[List[int]] = None,
+        token_normalize_scope: str = "global",
+        skip_update_rule: Optional[Tuple[str, str, float]] = None,
+        extra_fetch: Optional[Dict[str, jnp.ndarray]] = None,
+    ) -> Dict[str, float]:
+        """One optimizer step over the micro-batches ``mb_indices`` (default
+        all) of an uploaded batch: n_mbs grad dispatches + 1 apply + ONE
+        host sync. See train_batch for semantics."""
+        assert self.tx is not None, "engine built without an optimizer"
+        idxs = list(mb_indices) if mb_indices is not None else list(range(ub.n_mbs))
+        weights = [float(loss_weight_fn(ub.mbs[i])) for i in idxs]
+        total_w = sum(weights)
+        rule = None
+        cap = 0.0
+        if skip_update_rule is not None and skip_update_rule[2]:
+            rule = (skip_update_rule[0], skip_update_rule[1])
+            cap = float(skip_update_rule[2])
+        glob = token_normalize_scope == "global"
+        scale = 1.0 if glob else 1.0 / len(idxs)
+        aux_scale = (1.0 / len(idxs)) if glob else 1.0
+        carry = None
+        for i, w in zip(idxs, weights):
+            denom = total_w if glob else w
+            fn = self._get_sliced_grad_fn(
+                loss_fn, with_carry=carry is not None, R=ub.R
+            )
+            args = [
+                self.params, ub.grids, ub.seq, jnp.asarray(i, jnp.int32),
+                jnp.asarray(denom, jnp.float32),
+                jnp.asarray(scale, jnp.float32),
+                jnp.asarray(aux_scale, jnp.float32),
+            ]
+            if carry is not None:
+                args.append(carry)
+            with self._mesh_ctx():
+                carry = fn(*args)
+        loss_acc, stats_acc, grads_acc = carry
+        with self._mesh_ctx():
+            self.params, self.opt_state, gnorm, applied = self._get_apply_fn(
+                rule
+            )(
+                self.params, self.opt_state, grads_acc, dict(stats_acc),
+                jnp.asarray(cap, jnp.float32),
+            )
+        applied_lr = float(self.lr_schedule(self.opt_step_count))
+        fetched = jax.device_get({
+            **stats_acc, **(extra_fetch or {}), "loss": loss_acc,
+            "grad_norm": gnorm, "update_applied": applied,
+        })
+        if bool(fetched["update_applied"]):
+            self.opt_step_count += 1
+        out = {k: float(v) for k, v in fetched.items()}
+        for k in out:
+            if k.startswith("moe_"):
+                out[k] /= max(len(idxs), 1)
+        out["lr"] = applied_lr
+        out["total_tokens"] = float(sum(ub.mbs[i].n_tokens for i in idxs))
+        out["loss_weight"] = total_w
+        return out
 
     def _device_batch(self, mb: mbu.MicroBatch) -> Dict[str, jnp.ndarray]:
         d: Dict[str, jnp.ndarray] = {}
@@ -277,32 +525,26 @@ class JaxTrainEngine(TrainableEngine):
         if skip_update_rule is not None and skip_update_rule[2]:
             rule = (skip_update_rule[0], skip_update_rule[1])
             cap = float(skip_update_rule[2])
-        grad_fn = self._get_grad_fn(loss_fn)
 
-        grads_acc = None
-        loss_acc = None
-        stats_acc: Dict[str, Any] = {}
+        n_mbs = len(mbs)
+        glob = token_normalize_scope == "global"
+        scale = 1.0 if glob else 1.0 / n_mbs
+        aux_scale = (1.0 / n_mbs) if glob else 1.0
+        carry = None
         for mb, w in zip(mbs, weights):
-            denom = total_w if token_normalize_scope == "global" else w
+            denom = total_w if glob else w
             batch = self._device_batch(mb)
+            grad_fn = self._get_grad_fn(loss_fn, with_carry=carry is not None)
+            args = [
+                self.params, batch, jnp.asarray(denom, jnp.float32),
+                jnp.asarray(scale, jnp.float32),
+                jnp.asarray(aux_scale, jnp.float32),
+            ]
+            if carry is not None:
+                args.append(carry)
             with self._mesh_ctx():
-                loss, stats, grads = grad_fn(
-                    self.params, batch, jnp.asarray(denom, jnp.float32)
-                )
-            if token_normalize_scope != "global":
-                # mb scope: each micro-batch normalized by itself; average.
-                loss = loss / len(mbs)
-                grads = jax.tree.map(lambda g: g / len(mbs), grads)
-            grads_acc = (
-                grads
-                if grads_acc is None
-                else jax.tree.map(jnp.add, grads_acc, grads)
-            )
-            # Keep scalars on device: a float() here would sync the host
-            # into every micro-batch and stall the pipeline.
-            loss_acc = loss if loss_acc is None else loss_acc + loss
-            for k, v in stats.items():
-                stats_acc[k] = stats_acc[k] + v if k in stats_acc else v
+                carry = grad_fn(*args)
+        loss_acc, stats_acc, grads_acc = carry
 
         with self._mesh_ctx():
             self.params, self.opt_state, gnorm, applied = self._get_apply_fn(
@@ -328,6 +570,10 @@ class JaxTrainEngine(TrainableEngine):
         # Engine bookkeeping keys are written AFTER the user stats and would
         # clobber same-named loss_fn stats — keep them namespaced.
         out = {k: float(v) for k, v in fetched.items()}
+        # "moe_" stats are per-mb means accumulated as sums — report means.
+        for k in out:
+            if k.startswith("moe_"):
+                out[k] /= max(len(mbs), 1)
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(mb.n_tokens for mb in mbs))
         out["loss_weight"] = total_w
@@ -343,14 +589,25 @@ class JaxTrainEngine(TrainableEngine):
     def save_train_state(self, ckpt_dir: str) -> None:
         import os
 
+        from areal_tpu.parallel import distributed as dist
+
+        # Multi-host: every process joins the gather collective; only
+        # process 0 touches the filesystem.
+        host_params = dist.allgather_params(self.params)
+        host_opt = (
+            dist.allgather_params(self.opt_state)
+            if self.opt_state is not None else None
+        )
+        if jax.process_index() != 0:
+            return
         os.makedirs(ckpt_dir, exist_ok=True)
-        p_leaves = jax.tree_util.tree_leaves(self.params)
+        p_leaves = jax.tree_util.tree_leaves(host_params)
         np.savez(
             os.path.join(ckpt_dir, "params.npz"),
             **{f"p{i}": np.asarray(x) for i, x in enumerate(p_leaves)},
         )
-        if self.opt_state is not None:
-            o_leaves = jax.tree_util.tree_leaves(self.opt_state)
+        if host_opt is not None:
+            o_leaves = jax.tree_util.tree_leaves(host_opt)
             np.savez(
                 os.path.join(ckpt_dir, "opt_state.npz"),
                 **{f"o{i}": np.asarray(x) for i, x in enumerate(o_leaves)},

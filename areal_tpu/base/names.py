@@ -53,6 +53,12 @@ def model_version(experiment: str, trial: str, role: str) -> str:
     return f"{_base(experiment, trial)}/model_version/{role}"
 
 
+def model_version_time(experiment: str, trial: str, role: str) -> str:
+    """Wall-clock publish time of the version above — the start point of
+    the trainer→rollout weight-sync latency metric (BASELINE.json)."""
+    return f"{_base(experiment, trial)}/model_version_time/{role}"
+
+
 def experiment_status(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/exp_status"
 

@@ -18,6 +18,11 @@ class MoEConfig:
 
     num_experts: int = 8
     top_k: int = 2
+    # Expert-buffer size multiplier: capacity per expert is
+    # ceil(top_k * n_tokens * capacity_factor / num_experts); overflow
+    # tokens are dropped (contribute nothing), mirroring the reference's
+    # token_dispatcher capacity drop.
+    capacity_factor: float = 2.0
     routed_intermediate_dim: Optional[int] = None
     # qwen-moe style always-on shared expert; None = no shared expert
     shared_intermediate_dim: Optional[int] = None
@@ -46,6 +51,20 @@ class TransformerConfig:
     moe: Optional[MoEConfig] = None
     # sliding window attention (mistral/gemma2); None = full attention
     sliding_window: Optional[int] = None
+    # MLP activation: "silu" (llama family), "gelu_tanh" (gemma/gpt2),
+    # "gelu" (exact)
+    hidden_act: str = "silu"
+    # "gated" = SwiGLU/GeGLU (w_gate/w_up/w_down); "plain" = act(x@w_up)@w_down
+    # with biases (gpt2)
+    mlp_type: str = "gated"
+    norm_type: str = "rms"  # "rms" | "layer" (gpt2 LayerNorm with bias)
+    # "rope" | "learned" (gpt2 absolute position table)
+    pos_embedding: str = "rope"
+    max_position_embeddings: Optional[int] = None  # learned-pos table size
+    scale_embeddings: bool = False  # gemma: hidden *= sqrt(hidden_dim)
+    # HF family tag driving weight-name mapping + config.json emission
+    # (models/hf.py); None for fabricated test configs.
+    hf_family: Optional[str] = None
     dtype: str = "float32"  # param dtype; compute dtype chosen at call site
 
     @property

@@ -13,7 +13,7 @@ with KV cache). TPU-first differences:
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -207,12 +207,16 @@ def decode_chunk_rows(
     n_tokens: int,
     eos_token_id: int,
     pad_token_id: int,
+    row_budget: Optional[jnp.ndarray] = None,  # [B] max tokens THIS chunk
 ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
     """Continue decoding ``n_tokens`` from a decode state.
 
     Per-row sampling params (temperature/top_k/top_p/greedy/min_new_tokens)
     are DYNAMIC [B] arrays: one compiled kernel serves arbitrary gconfig
-    mixes, so the server batches purely by computation shape.
+    mixes, so the server batches purely by computation shape. ``row_budget``
+    finishes a row after its own token allowance even when the (static)
+    chunk length is longer — mixed-budget batches stop sampling for
+    exhausted rows instead of generating tokens the caller would discard.
 
     Returns (new_state, out) with out like generate_batch's (output_ids /
     output_logprobs / output_lens / gen_mask). Equivalent to the tail of
@@ -225,6 +229,8 @@ def decode_chunk_rows(
 
     def step(carry, n):
         kv_k, kv_v, last_logits, cur_len, done, finished, key = carry
+        if row_budget is not None:
+            finished = finished | (n >= row_budget)
         key, sub = jax.random.split(key)
         logits = last_logits
         # Forbid EOS while a row is under its min_new_tokens budget.

@@ -1,26 +1,31 @@
-"""Bidirectional HF ↔ areal_tpu weight conversion.
+"""Bidirectional HF ↔ areal_tpu weight conversion + sharded safetensors IO.
 
 Parity target: the reference's per-family converter registry
 (``realhf/impl/model/conversion/hf_registry.py:32`` +
-``realhf/api/from_hf/{llama,qwen2,qwen3,...}.py``). Families covered here:
-llama, qwen2, qwen2.5 (same as qwen2), qwen3, mistral — all share the
-rotate-half RoPE / RMSNorm / gated-SiLU skeleton and differ only in flags.
+``realhf/api/from_hf/{llama,qwen2,qwen3,gemma,gpt2,mistral,mixtral}.py``).
+Families covered: llama, qwen2 (qwen2.5), qwen3, mistral, gemma, gpt2,
+mixtral, qwen3_moe.
 
 Weights are stacked on a leading layer axis (see models/transformer.py), so
 conversion transposes HF's ``[out, in]`` linear layout to ``[in, out]`` and
-stacks per-layer tensors.
+stacks per-layer tensors. Checkpoints are written as sharded safetensors
+with an HF-style index (threaded writers, mirroring the reference's
+``saveload_utils.py``) plus a genuine HF ``config.json`` so the output loads
+directly in ``transformers.AutoModelForCausalLM``.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Callable, Dict, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from areal_tpu.base import logging
-from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.config import MoEConfig, TransformerConfig
 
 logger = logging.getLogger("models.hf")
 
@@ -35,15 +40,11 @@ def register_hf_family(name: str):
     return deco
 
 
-def config_from_hf(hf_config: Any) -> TransformerConfig:
-    """Build a TransformerConfig from a transformers PretrainedConfig."""
-    mt = getattr(hf_config, "model_type", "llama")
-    if mt not in HF_FAMILIES:
-        raise NotImplementedError(f"unsupported HF model family: {mt}")
+def _base_kwargs(hf_config: Any) -> Dict[str, Any]:
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
-    return TransformerConfig(
+    return dict(
         n_layers=hf_config.num_hidden_layers,
         hidden_dim=hf_config.hidden_size,
         n_q_heads=hf_config.num_attention_heads,
@@ -55,16 +56,98 @@ def config_from_hf(hf_config: Any) -> TransformerConfig:
         rotary_base=getattr(hf_config, "rope_theta", 10000.0),
         rms_norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+def _llama_like(hf_config: Any) -> TransformerConfig:
+    mt = getattr(hf_config, "model_type", "llama")
+    return TransformerConfig(
+        **_base_kwargs(hf_config),
         sliding_window=getattr(hf_config, "sliding_window", None)
         if getattr(hf_config, "use_sliding_window", True)
         else None,
         use_attention_bias=mt in ("qwen2",),
-        use_qk_norm=mt in ("qwen3",),
+        use_qk_norm=mt in ("qwen3", "qwen3_moe"),
+        hf_family=mt,
     )
 
 
 for _fam in ("llama", "qwen2", "qwen3", "mistral"):
-    register_hf_family(_fam)(config_from_hf)
+    register_hf_family(_fam)(_llama_like)
+
+
+@register_hf_family("gemma")
+def _gemma_config(hf_config: Any) -> TransformerConfig:
+    act = getattr(hf_config, "hidden_activation", None) or "gelu_pytorch_tanh"
+    return TransformerConfig(
+        **_base_kwargs(hf_config),
+        hidden_act="gelu_tanh" if "tanh" in act else "gelu",
+        scale_embeddings=True,
+        hf_family="gemma",
+    )
+
+
+@register_hf_family("gpt2")
+def _gpt2_config(hf_config: Any) -> TransformerConfig:
+    d = hf_config.n_embd
+    return TransformerConfig(
+        n_layers=hf_config.n_layer,
+        hidden_dim=d,
+        n_q_heads=hf_config.n_head,
+        n_kv_heads=hf_config.n_head,
+        head_dim=d // hf_config.n_head,
+        intermediate_dim=hf_config.n_inner or 4 * d,
+        vocab_size=hf_config.vocab_size,
+        rms_norm_eps=hf_config.layer_norm_epsilon,
+        tie_word_embeddings=True,
+        use_attention_bias=True,
+        use_attn_output_bias=True,
+        hidden_act="gelu_tanh",  # gelu_new
+        mlp_type="plain",
+        norm_type="layer",
+        pos_embedding="learned",
+        max_position_embeddings=hf_config.n_positions,
+        hf_family="gpt2",
+    )
+
+
+@register_hf_family("mixtral")
+def _mixtral_config(hf_config: Any) -> TransformerConfig:
+    return TransformerConfig(
+        **_base_kwargs(hf_config),
+        sliding_window=getattr(hf_config, "sliding_window", None),
+        moe=MoEConfig(
+            num_experts=hf_config.num_local_experts,
+            top_k=hf_config.num_experts_per_tok,
+            aux_loss_coeff=getattr(hf_config, "router_aux_loss_coef", 1e-3),
+            norm_topk_prob=True,
+        ),
+        hf_family="mixtral",
+    )
+
+
+@register_hf_family("qwen3_moe")
+def _qwen3_moe_config(hf_config: Any) -> TransformerConfig:
+    return TransformerConfig(
+        **_base_kwargs(hf_config),
+        use_qk_norm=True,
+        moe=MoEConfig(
+            num_experts=hf_config.num_experts,
+            top_k=hf_config.num_experts_per_tok,
+            routed_intermediate_dim=hf_config.moe_intermediate_size,
+            aux_loss_coeff=getattr(hf_config, "router_aux_loss_coef", 1e-3),
+            norm_topk_prob=getattr(hf_config, "norm_topk_prob", True),
+        ),
+        hf_family="qwen3_moe",
+    )
+
+
+def config_from_hf(hf_config: Any) -> TransformerConfig:
+    """Build a TransformerConfig from a transformers PretrainedConfig."""
+    mt = getattr(hf_config, "model_type", "llama")
+    if mt not in HF_FAMILIES:
+        raise NotImplementedError(f"unsupported HF model family: {mt}")
+    return HF_FAMILIES[mt](hf_config)
 
 
 def _np(t) -> np.ndarray:
@@ -73,11 +156,63 @@ def _np(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def params_from_hf_state_dict(
-    sd: Dict[str, Any], cfg: TransformerConfig, dtype: str = "float32"
-) -> Dict[str, Any]:
-    """HF causal-LM state dict → stacked areal_tpu param pytree (numpy)."""
+# ---------------- family weight codecs ----------------
+#
+# Each codec maps between an HF state dict (flat names, [out, in] linears)
+# and the stacked areal_tpu pytree. The llama-style codec covers every
+# family except gpt2 (fused c_attn + Conv1D layout).
 
+
+def _llama_mapping(cfg: TransformerConfig) -> List[tuple]:
+    """(pytree key, HF name fmt, transpose) for per-layer 2-D/1-D weights."""
+    m = [
+        ("ln1", "model.layers.{i}.input_layernorm.weight", False),
+        ("ln2", "model.layers.{i}.post_attention_layernorm.weight", False),
+        ("wq", "model.layers.{i}.self_attn.q_proj.weight", True),
+        ("wk", "model.layers.{i}.self_attn.k_proj.weight", True),
+        ("wv", "model.layers.{i}.self_attn.v_proj.weight", True),
+        ("wo", "model.layers.{i}.self_attn.o_proj.weight", True),
+    ]
+    if cfg.moe is None:
+        m += [
+            ("w_gate", "model.layers.{i}.mlp.gate_proj.weight", True),
+            ("w_up", "model.layers.{i}.mlp.up_proj.weight", True),
+            ("w_down", "model.layers.{i}.mlp.down_proj.weight", True),
+        ]
+    if cfg.use_attention_bias:
+        m += [
+            ("bq", "model.layers.{i}.self_attn.q_proj.bias", False),
+            ("bk", "model.layers.{i}.self_attn.k_proj.bias", False),
+            ("bv", "model.layers.{i}.self_attn.v_proj.bias", False),
+        ]
+    if cfg.use_qk_norm:
+        m += [
+            ("q_norm", "model.layers.{i}.self_attn.q_norm.weight", False),
+            ("k_norm", "model.layers.{i}.self_attn.k_norm.weight", False),
+        ]
+    return m
+
+
+def _moe_names(cfg: TransformerConfig) -> Dict[str, str]:
+    if cfg.hf_family == "mixtral":
+        return {
+            "router": "model.layers.{i}.block_sparse_moe.gate.weight",
+            "e_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+            "e_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+            "e_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+        }
+    # qwen3_moe layout
+    return {
+        "router": "model.layers.{i}.mlp.gate.weight",
+        "e_gate": "model.layers.{i}.mlp.experts.{e}.gate_proj.weight",
+        "e_up": "model.layers.{i}.mlp.experts.{e}.up_proj.weight",
+        "e_down": "model.layers.{i}.mlp.experts.{e}.down_proj.weight",
+    }
+
+
+def _llama_from_sd(
+    sd: Dict[str, Any], cfg: TransformerConfig, dtype: str
+) -> Dict[str, Any]:
     def get(name):
         if name in sd:
             return _np(sd[name])
@@ -90,36 +225,31 @@ def params_from_hf_state_dict(
             ws.append(w.T if transpose and w.ndim == 2 else w)
         return np.stack(ws).astype(dtype)
 
-    layers: Dict[str, np.ndarray] = {
-        "ln1": stack("model.layers.{i}.input_layernorm.weight", transpose=False),
-        "ln2": stack(
-            "model.layers.{i}.post_attention_layernorm.weight", transpose=False
-        ),
-        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
-        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
-        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
-        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
-        "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
-        "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
-        "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
-    }
-    if cfg.use_attention_bias:
-        layers["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False)
-        layers["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False)
-        layers["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False)
-    if cfg.use_qk_norm:
-        layers["q_norm"] = stack(
-            "model.layers.{i}.self_attn.q_norm.weight", transpose=False
-        )
-        layers["k_norm"] = stack(
-            "model.layers.{i}.self_attn.k_norm.weight", transpose=False
-        )
+    layers: Dict[str, np.ndarray] = {}
+    for key, fmt, tr in _llama_mapping(cfg):
+        layers[key] = stack(fmt, transpose=tr)
+    if cfg.moe is not None:
+        names = _moe_names(cfg)
+        E = cfg.moe.num_experts
+        layers["router"] = stack(names["router"])  # [n, D, E]
+        for key in ("e_gate", "e_up", "e_down"):
+            per_layer = []
+            for i in range(cfg.n_layers):
+                per_layer.append(np.stack([
+                    _np(sd[names[key].format(i=i, e=e)]).T for e in range(E)
+                ]))
+            layers[key] = np.stack(per_layer).astype(dtype)  # [n, E, ., .]
+    if cfg.scale_embeddings:  # gemma stores norm weights as (w − 1)
+        for k in ("ln1", "ln2"):
+            layers[k] = (layers[k] + 1.0).astype(dtype)
 
     params: Dict[str, Any] = {
         "embedding": get("model.embed_tokens.weight").astype(dtype),
         "layers": layers,
         "final_ln": get("model.norm.weight").astype(dtype),
     }
+    if cfg.scale_embeddings:
+        params["final_ln"] = (params["final_ln"] + 1.0).astype(dtype)
     if cfg.is_critic:
         if "score.weight" in sd:
             params["value_head"] = get("score.weight").T.astype(dtype)
@@ -130,51 +260,281 @@ def params_from_hf_state_dict(
     return params
 
 
-def params_to_hf_state_dict(
+def _llama_to_sd(
     params: Dict[str, Any], cfg: TransformerConfig
 ) -> Dict[str, np.ndarray]:
-    """Inverse conversion (for publishing weights / HF-format checkpoints)."""
-
-    def unstack(key, name_fmt, transpose=True):
-        w = np.asarray(params["layers"][key])
-        for i in range(cfg.n_layers):
-            wi = w[i]
-            yield name_fmt.format(i=i), (wi.T if transpose and wi.ndim == 2 else wi)
-
+    layers = {k: np.asarray(v) for k, v in params["layers"].items()}
+    if cfg.scale_embeddings:  # undo the gemma (w + 1) fold
+        layers = dict(layers)
+        for k in ("ln1", "ln2"):
+            layers[k] = layers[k] - 1.0
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(params["embedding"]),
-        "model.norm.weight": np.asarray(params["final_ln"]),
+        "model.norm.weight": np.asarray(params["final_ln"])
+        - (1.0 if cfg.scale_embeddings else 0.0),
     }
-    mapping = [
-        ("ln1", "model.layers.{i}.input_layernorm.weight", False),
-        ("ln2", "model.layers.{i}.post_attention_layernorm.weight", False),
-        ("wq", "model.layers.{i}.self_attn.q_proj.weight", True),
-        ("wk", "model.layers.{i}.self_attn.k_proj.weight", True),
-        ("wv", "model.layers.{i}.self_attn.v_proj.weight", True),
-        ("wo", "model.layers.{i}.self_attn.o_proj.weight", True),
-        ("w_gate", "model.layers.{i}.mlp.gate_proj.weight", True),
-        ("w_up", "model.layers.{i}.mlp.up_proj.weight", True),
-        ("w_down", "model.layers.{i}.mlp.down_proj.weight", True),
-    ]
-    if cfg.use_attention_bias:
-        mapping += [
-            ("bq", "model.layers.{i}.self_attn.q_proj.bias", False),
-            ("bk", "model.layers.{i}.self_attn.k_proj.bias", False),
-            ("bv", "model.layers.{i}.self_attn.v_proj.bias", False),
-        ]
-    if cfg.use_qk_norm:
-        mapping += [
-            ("q_norm", "model.layers.{i}.self_attn.q_norm.weight", False),
-            ("k_norm", "model.layers.{i}.self_attn.k_norm.weight", False),
-        ]
-    for key, fmt, tr in mapping:
-        for name, w in unstack(key, fmt, tr):
-            sd[name] = w
+    for key, fmt, tr in _llama_mapping(cfg):
+        w = layers[key]
+        for i in range(cfg.n_layers):
+            wi = w[i]
+            sd[fmt.format(i=i)] = wi.T if tr and wi.ndim == 2 else wi
+    if cfg.moe is not None:
+        names = _moe_names(cfg)
+        for i in range(cfg.n_layers):
+            sd[names["router"].format(i=i)] = layers["router"][i].T
+            for key in ("e_gate", "e_up", "e_down"):
+                for e in range(cfg.moe.num_experts):
+                    sd[names[key].format(i=i, e=e)] = layers[key][i, e].T
     if cfg.is_critic:
         sd["score.weight"] = np.asarray(params["value_head"]).T
     elif not cfg.tie_word_embeddings:
         sd["lm_head.weight"] = np.asarray(params["lm_head"]).T
     return sd
+
+
+def _gpt2_from_sd(
+    sd: Dict[str, Any], cfg: TransformerConfig, dtype: str
+) -> Dict[str, Any]:
+    """GPT-2: fused c_attn qkv, Conv1D layout ([in, out] — NO transpose),
+    LayerNorm weights+biases, learned positions, 'transformer.' prefix
+    (absent when loading from a bare GPT2Model state dict)."""
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+
+    def get(name):
+        return _np(sd[pfx + name])
+
+    d = cfg.hidden_dim
+    n = cfg.n_layers
+
+    def stack(fmt):
+        return np.stack([_np(sd[pfx + fmt.format(i=i)]) for i in range(n)])
+
+    c_attn_w = stack("h.{i}.attn.c_attn.weight")  # [n, d, 3d] Conv1D
+    c_attn_b = stack("h.{i}.attn.c_attn.bias")  # [n, 3d]
+    layers = {
+        "ln1": stack("h.{i}.ln_1.weight").astype(dtype),
+        "ln1_b": stack("h.{i}.ln_1.bias").astype(dtype),
+        "ln2": stack("h.{i}.ln_2.weight").astype(dtype),
+        "ln2_b": stack("h.{i}.ln_2.bias").astype(dtype),
+        "wq": c_attn_w[:, :, :d].astype(dtype),
+        "wk": c_attn_w[:, :, d : 2 * d].astype(dtype),
+        "wv": c_attn_w[:, :, 2 * d :].astype(dtype),
+        "bq": c_attn_b[:, :d].astype(dtype),
+        "bk": c_attn_b[:, d : 2 * d].astype(dtype),
+        "bv": c_attn_b[:, 2 * d :].astype(dtype),
+        "wo": stack("h.{i}.attn.c_proj.weight").astype(dtype),
+        "bo": stack("h.{i}.attn.c_proj.bias").astype(dtype),
+        "w_up": stack("h.{i}.mlp.c_fc.weight").astype(dtype),
+        "b_up": stack("h.{i}.mlp.c_fc.bias").astype(dtype),
+        "w_down": stack("h.{i}.mlp.c_proj.weight").astype(dtype),
+        "b_down": stack("h.{i}.mlp.c_proj.bias").astype(dtype),
+    }
+    return {
+        "embedding": get("wte.weight").astype(dtype),
+        "pos_embedding": get("wpe.weight").astype(dtype),
+        "layers": layers,
+        "final_ln": get("ln_f.weight").astype(dtype),
+        "final_ln_b": get("ln_f.bias").astype(dtype),
+    }
+
+
+def _gpt2_to_sd(
+    params: Dict[str, Any], cfg: TransformerConfig
+) -> Dict[str, np.ndarray]:
+    lp = {k: np.asarray(v) for k, v in params["layers"].items()}
+    sd = {
+        "transformer.wte.weight": np.asarray(params["embedding"]),
+        "transformer.wpe.weight": np.asarray(params["pos_embedding"]),
+        "transformer.ln_f.weight": np.asarray(params["final_ln"]),
+        "transformer.ln_f.bias": np.asarray(params["final_ln_b"]),
+    }
+    for i in range(cfg.n_layers):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = lp["ln1"][i]
+        sd[p + "ln_1.bias"] = lp["ln1_b"][i]
+        sd[p + "ln_2.weight"] = lp["ln2"][i]
+        sd[p + "ln_2.bias"] = lp["ln2_b"][i]
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [lp["wq"][i], lp["wk"][i], lp["wv"][i]], axis=1
+        )
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [lp["bq"][i], lp["bk"][i], lp["bv"][i]]
+        )
+        sd[p + "attn.c_proj.weight"] = lp["wo"][i]
+        sd[p + "attn.c_proj.bias"] = lp["bo"][i]
+        sd[p + "mlp.c_fc.weight"] = lp["w_up"][i]
+        sd[p + "mlp.c_fc.bias"] = lp["b_up"][i]
+        sd[p + "mlp.c_proj.weight"] = lp["w_down"][i]
+        sd[p + "mlp.c_proj.bias"] = lp["b_down"][i]
+    return sd
+
+
+def params_from_hf_state_dict(
+    sd: Dict[str, Any], cfg: TransformerConfig, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF causal-LM state dict → stacked areal_tpu param pytree (numpy)."""
+    if cfg.hf_family == "gpt2":
+        return _gpt2_from_sd(sd, cfg, dtype)
+    return _llama_from_sd(sd, cfg, dtype)
+
+
+def params_to_hf_state_dict(
+    params: Dict[str, Any], cfg: TransformerConfig
+) -> Dict[str, np.ndarray]:
+    """Inverse conversion (for publishing weights / HF-format checkpoints)."""
+    if cfg.hf_family == "gpt2":
+        return _gpt2_to_sd(params, cfg)
+    return _llama_to_sd(params, cfg)
+
+
+# ---------------- HF config.json emission ----------------
+
+_HF_ARCH = {
+    "llama": "LlamaForCausalLM",
+    "qwen2": "Qwen2ForCausalLM",
+    "qwen3": "Qwen3ForCausalLM",
+    "mistral": "MistralForCausalLM",
+    "gemma": "GemmaForCausalLM",
+    "gpt2": "GPT2LMHeadModel",
+    "mixtral": "MixtralForCausalLM",
+    "qwen3_moe": "Qwen3MoeForCausalLM",
+}
+
+
+def hf_config_dict(cfg: TransformerConfig) -> Dict[str, Any]:
+    """A transformers-loadable config.json dict for ``cfg``'s family."""
+    fam = cfg.hf_family or "llama"
+    if fam == "gpt2":
+        return {
+            "model_type": "gpt2",
+            "architectures": ["GPT2LMHeadModel"],
+            "n_layer": cfg.n_layers,
+            "n_embd": cfg.hidden_dim,
+            "n_head": cfg.n_q_heads,
+            "n_positions": cfg.max_position_embeddings,
+            "n_ctx": cfg.max_position_embeddings,
+            "n_inner": cfg.intermediate_dim,
+            "vocab_size": cfg.vocab_size,
+            "layer_norm_epsilon": cfg.rms_norm_eps,
+            "activation_function": "gelu_new",
+            "tie_word_embeddings": True,
+        }
+    d: Dict[str, Any] = {
+        "model_type": fam,
+        "architectures": [_HF_ARCH.get(fam, "LlamaForCausalLM")],
+        "num_hidden_layers": cfg.n_layers,
+        "hidden_size": cfg.hidden_dim,
+        "num_attention_heads": cfg.n_q_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "rope_theta": cfg.rotary_base,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "max_position_embeddings": cfg.max_position_embeddings or 32768,
+        "hidden_act": "gelu_pytorch_tanh"
+        if cfg.hidden_act == "gelu_tanh" and fam == "gemma"
+        else ("silu" if cfg.hidden_act == "silu" else cfg.hidden_act),
+        "torch_dtype": "float32",
+    }
+    if fam == "gemma":
+        d["hidden_activation"] = "gelu_pytorch_tanh"
+    if cfg.sliding_window is not None:
+        d["sliding_window"] = cfg.sliding_window
+    if cfg.moe is not None:
+        if fam == "mixtral":
+            d["num_local_experts"] = cfg.moe.num_experts
+            d["num_experts_per_tok"] = cfg.moe.top_k
+            d["router_aux_loss_coef"] = cfg.moe.aux_loss_coeff
+        else:
+            d["num_experts"] = cfg.moe.num_experts
+            d["num_experts_per_tok"] = cfg.moe.top_k
+            d["moe_intermediate_size"] = (
+                cfg.moe.routed_intermediate_dim or cfg.intermediate_dim
+            )
+            d["norm_topk_prob"] = cfg.moe.norm_topk_prob
+            d["router_aux_loss_coef"] = cfg.moe.aux_loss_coeff
+            d["decoder_sparse_step"] = 1
+            d["mlp_only_layers"] = []
+    return d
+
+
+# ---------------- sharded safetensors IO ----------------
+
+SHARD_BYTES = 4 * 1024**3  # ~4GB per shard, HF convention
+
+
+def save_hf_state_dict(
+    sd: Dict[str, np.ndarray], save_dir: str, shard_bytes: int = SHARD_BYTES,
+    n_threads: int = 8,
+) -> None:
+    """Write ``sd`` as sharded safetensors + index (threaded, one writer per
+    shard — parity: reference saveload_utils.py threaded safetensor save)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(save_dir, exist_ok=True)
+    shards: List[Dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for k, v in sd.items():
+        v = np.ascontiguousarray(v)
+        nb = v.nbytes
+        if sizes[-1] > 0 and sizes[-1] + nb > shard_bytes:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][k] = v
+        sizes[-1] += nb
+    n = len(shards)
+    if n == 1:
+        save_file(shards[0], os.path.join(save_dir, "model.safetensors"))
+        return
+    names = [
+        f"model-{i + 1:05d}-of-{n:05d}.safetensors" for i in range(n)
+    ]
+    with ThreadPoolExecutor(max_workers=min(n_threads, n)) as ex:
+        list(ex.map(
+            lambda iv: save_file(
+                shards[iv[0]], os.path.join(save_dir, iv[1])
+            ),
+            enumerate(names),
+        ))
+    index = {
+        "metadata": {"total_size": int(sum(sizes))},
+        "weight_map": {
+            k: names[i] for i, shard in enumerate(shards) for k in shard
+        },
+    }
+    with open(os.path.join(save_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump(index, f)
+
+
+def load_hf_state_dict(load_dir: str, n_threads: int = 8) -> Dict[str, np.ndarray]:
+    """Load a safetensors checkpoint dir (sharded or single-file); falls
+    back to the legacy model.npz layout."""
+    single = os.path.join(load_dir, "model.safetensors")
+    index_path = os.path.join(load_dir, "model.safetensors.index.json")
+    legacy = os.path.join(load_dir, "model.npz")
+    from safetensors.numpy import load_file
+
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        files = sorted(set(index["weight_map"].values()))
+        out: Dict[str, np.ndarray] = {}
+        with ThreadPoolExecutor(max_workers=min(n_threads, len(files))) as ex:
+            for d in ex.map(
+                lambda fn: load_file(os.path.join(load_dir, fn)), files
+            ):
+                out.update(d)
+        return out
+    if os.path.exists(single):
+        return load_file(single)
+    if os.path.exists(legacy):
+        return dict(np.load(legacy))
+    raise FileNotFoundError(f"no model.safetensors[.index.json] in {load_dir}")
+
+
+# ---------------- high-level load/save ----------------
 
 
 def load_hf_model(path_or_model, is_critic: bool = False, dtype: str = "float32"):
@@ -193,39 +553,40 @@ def load_hf_model(path_or_model, is_critic: bool = False, dtype: str = "float32"
         model = path_or_model
         hf_cfg = model.config
         tokenizer = None
-    import dataclasses
-
     cfg = dataclasses.replace(config_from_hf(hf_cfg), is_critic=is_critic)
     params = params_from_hf_state_dict(model.state_dict(), cfg, dtype)
     return cfg, params, tokenizer
 
 
-def save_hf_checkpoint(params, cfg: TransformerConfig, save_dir: str, meta: Optional[dict] = None):
-    """Publish weights in a layout consumable by the generation server and by
-    HF tooling: one .npz of the HF-named state dict + a config json. (The
-    disk weight-sync path; reference saves HF safetensor shards.)"""
+def save_hf_checkpoint(
+    params, cfg: TransformerConfig, save_dir: str, meta: Optional[dict] = None
+):
+    """Publish weights in a layout consumable by BOTH the generation server
+    (areal_tpu_config.json round-trip) and HF tooling (sharded safetensors +
+    genuine config.json → transformers.AutoModelForCausalLM loads it).
+    Replaces the r1/r2 npz layout (reference: hf_registry.py:32 save)."""
     os.makedirs(save_dir, exist_ok=True)
     sd = params_to_hf_state_dict(params, cfg)
-    np.savez(os.path.join(save_dir, "model.npz"), **sd)
-    import dataclasses
-
+    save_hf_state_dict(sd, save_dir)
     with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(hf_config_dict(cfg), f, indent=1)
+    with open(os.path.join(save_dir, "areal_tpu_config.json"), "w") as f:
         json.dump(
             {"areal_tpu_config": dataclasses.asdict(cfg), "meta": meta or {}}, f
         )
 
 
 def load_hf_checkpoint(load_dir: str):
-    import dataclasses
-
-    with open(os.path.join(load_dir, "config.json")) as f:
+    acfg_path = os.path.join(load_dir, "areal_tpu_config.json")
+    if not os.path.exists(acfg_path):
+        # Legacy r2 layout kept config under config.json.
+        acfg_path = os.path.join(load_dir, "config.json")
+    with open(acfg_path) as f:
         d = json.load(f)
-    from areal_tpu.models.config import MoEConfig
-
     cd = d["areal_tpu_config"]
     if cd.get("moe"):
         cd["moe"] = MoEConfig(**cd["moe"])
     cfg = TransformerConfig(**cd)
-    sd = dict(np.load(os.path.join(load_dir, "model.npz")))
+    sd = load_hf_state_dict(load_dir)
     params = params_from_hf_state_dict(sd, cfg, cfg.dtype)
     return cfg, params

@@ -41,16 +41,33 @@ def moe_mlp(
     lp: Dict[str, jnp.ndarray],  # this layer's params
     moe: MoEConfig,
     rng: jnp.ndarray = None,  # jitter noise (training only); None = off
+    mask: jnp.ndarray = None,  # [B, T] bool/int — True for real tokens
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Returns (output [B, T, D], aux dict with load_balance_loss / z_loss /
-    aux_total / dropped_frac)."""
+    aux_total / dropped_frac).
+
+    ``mask`` excludes grid-padding tokens from routing entirely: they take
+    no expert-capacity slots and do not enter the balancing/z statistics
+    (the reference runs on unpadded packed tokens, so padding never exists
+    there; with [B, T] grids it must be masked out explicitly)."""
     B, T, D = x.shape
     E, k = moe.num_experts, moe.top_k
     N = B * T
     xf = x.reshape(N, D)
+    valid = (
+        jnp.ones((N,), jnp.float32) if mask is None
+        else mask.reshape(N).astype(jnp.float32)
+    )
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
 
     router_in = xf
-    if rng is not None and moe.input_jitter_eps > 0:
+    if moe.input_jitter_eps > 0:
+        if rng is None:
+            raise NotImplementedError(
+                "input_jitter_eps > 0 needs an rng key threaded into the "
+                "forward pass; jitter is not wired yet (reference "
+                "router.py:170) — set input_jitter_eps=0"
+            )
         eps = moe.input_jitter_eps
         router_in = xf * jax.random.uniform(
             rng, xf.shape, minval=1 - eps, maxval=1 + eps, dtype=xf.dtype
@@ -64,25 +81,27 @@ def moe_mlp(
         )
 
     # ---- balancing losses (reference router.py:78,146) ----
-    # f_e: fraction of tokens routed to expert e; P_e: mean router prob.
+    # f_e: fraction of (real) tokens routed to expert e; P_e: mean prob.
     onehot = jax.nn.one_hot(top_i, E, dtype=jnp.float32)  # [N, k, E]
+    onehot = onehot * valid[:, None, None]  # padding routes nowhere
     routed = jnp.sum(onehot, axis=1)  # [N, E] 0/1 counts
-    f = jnp.mean(routed, axis=0) * E / k
-    P = jnp.mean(probs, axis=0)
+    f = jnp.sum(routed, axis=0) / n_valid * E / k
+    P = jnp.sum(probs * valid[:, None], axis=0) / n_valid
     load_balance = jnp.sum(f * P)
-    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    z = jnp.sum((jax.nn.logsumexp(logits, axis=-1) ** 2) * valid) / n_valid
     aux_total = moe.aux_loss_coeff * load_balance + moe.z_loss_coeff * z
 
     # ---- capacity dispatch ----
     C = capacity(N, moe)
     # position of each (token, choice) within its expert buffer: priority is
-    # token order then choice order (same as the reference's dispatcher).
+    # token order then choice order (same as the reference's dispatcher);
+    # padding tokens have zeroed onehot and consume no slots.
     flat_oh = onehot.reshape(N * k, E)
     pos = (jnp.cumsum(flat_oh, axis=0) - flat_oh).reshape(N, k, E)
     pos = jnp.sum(pos * onehot, axis=-1)  # [N, k] slot per choice
-    keep = pos < C
+    keep = (pos < C) & (jnp.sum(onehot, axis=-1) > 0)
     gate = top_p * keep  # dropped tokens contribute nothing
-    dropped_frac = 1.0 - jnp.sum(keep) / (N * k)
+    dropped_frac = 1.0 - jnp.sum(keep) / jnp.maximum(n_valid * k, 1.0)
 
     # combine [N, E, C] — sparse; also serves (as booleans) for dispatch.
     slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)

@@ -35,10 +35,6 @@ Params = Dict[str, Any]
 # ---------------- init ----------------
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
-    if cfg.moe is not None:
-        raise NotImplementedError(
-            "MoE layers are built by areal_tpu.models.moe (pending); dense only"
-        )
     dtype = jnp.dtype(cfg.dtype)
     n, d, dh = cfg.n_layers, cfg.hidden_dim, cfg.head_dim
     qd, kvd, f = cfg.q_dim, cfg.kv_dim, cfg.intermediate_dim
@@ -54,10 +50,24 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         "wk": nrm(keys[1], (n, d, kvd)),
         "wv": nrm(keys[2], (n, d, kvd)),
         "wo": nrm(keys[3], (n, qd, d)),
-        "w_gate": nrm(keys[4], (n, d, f)),
-        "w_up": nrm(keys[5], (n, d, f)),
-        "w_down": nrm(keys[6], (n, f, d)),
     }
+    if cfg.moe is not None:
+        from areal_tpu.models import moe as moemod
+
+        layers.update(moemod.init_moe_params(cfg, keys[4], dtype))
+    elif cfg.mlp_type == "plain":
+        layers.update({
+            "w_up": nrm(keys[5], (n, d, f)),
+            "w_down": nrm(keys[6], (n, f, d)),
+            "b_up": jnp.zeros((n, f), dtype),
+            "b_down": jnp.zeros((n, d), dtype),
+        })
+    else:
+        layers.update({
+            "w_gate": nrm(keys[4], (n, d, f)),
+            "w_up": nrm(keys[5], (n, d, f)),
+            "w_down": nrm(keys[6], (n, f, d)),
+        })
     if cfg.use_attention_bias:
         layers["bq"] = jnp.zeros((n, qd), dtype)
         layers["bk"] = jnp.zeros((n, kvd), dtype)
@@ -67,12 +77,24 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     if cfg.use_qk_norm:
         layers["q_norm"] = jnp.ones((n, dh), dtype)
         layers["k_norm"] = jnp.ones((n, dh), dtype)
+    if cfg.norm_type == "layer":
+        layers["ln1_b"] = jnp.zeros((n, d), dtype)
+        layers["ln2_b"] = jnp.zeros((n, d), dtype)
 
     params: Params = {
         "embedding": nrm(keys[7], (cfg.vocab_size, d)),
         "layers": layers,
         "final_ln": jnp.ones((d,), dtype),
     }
+    if cfg.norm_type == "layer":
+        params["final_ln_b"] = jnp.zeros((d,), dtype)
+    if cfg.pos_embedding == "learned":
+        assert cfg.max_position_embeddings, (
+            "learned position embeddings need max_position_embeddings"
+        )
+        params["pos_embedding"] = nrm(
+            keys[9], (cfg.max_position_embeddings, d)
+        )
     if cfg.is_critic:
         params["value_head"] = nrm(keys[8], (d, 1))
     elif not cfg.tie_word_embeddings:
@@ -87,6 +109,29 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     return (w * (x32 * jax.lax.rsqrt(var + eps)).astype(dt)).astype(dt)
+
+
+def layer_norm(
+    x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray, eps: float
+) -> jnp.ndarray:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return (w * ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(dt) + b).astype(dt)
+
+
+def _norm(cfg: TransformerConfig, x, lp, key: str) -> jnp.ndarray:
+    if cfg.norm_type == "layer":
+        return layer_norm(x, lp[key], lp[key + "_b"], cfg.rms_norm_eps)
+    return rms_norm(x, lp[key], cfg.rms_norm_eps)
+
+
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
 
 
 def rope_tables(
@@ -124,11 +169,11 @@ def _block(
     cache_write_index: Optional[jnp.ndarray],
     kv_valid: Optional[jnp.ndarray],
     attn_impl: str,
-) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], Optional[Dict[str, jnp.ndarray]]]:
     B, T, D = h.shape
     dh = cfg.head_dim
 
-    x = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+    x = _norm(cfg, h, lp, "ln1")
     q = x @ lp["wq"]
     k = x @ lp["wk"]
     v = x @ lp["wv"]
@@ -142,8 +187,9 @@ def _block(
     if cfg.use_qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if cfg.pos_embedding == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
 
     if cache_kv is None:
         mesh = current_mesh()
@@ -195,9 +241,21 @@ def _block(
         attn = attn + lp["bo"]
     h = constrain(h + attn, hid)
 
-    x = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-    return constrain(h + mlp, hid), new_kv
+    x = _norm(cfg, h, lp, "ln2")
+    aux = None
+    act = _ACTIVATIONS[cfg.hidden_act]
+    if cfg.moe is not None:
+        from areal_tpu.models import moe as moemod
+
+        mlp, aux = moemod.moe_mlp(
+            x, lp, cfg.moe,
+            mask=(segment_ids > 0) if segment_ids is not None else None,
+        )
+    elif cfg.mlp_type == "plain":
+        mlp = act(x @ lp["w_up"] + lp["b_up"]) @ lp["w_down"] + lp["b_down"]
+    else:
+        mlp = (act(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return constrain(h + mlp, hid), new_kv, aux
 
 
 # ---------------- forward ----------------
@@ -214,10 +272,13 @@ def forward(
     attn_impl: str = "auto",
     remat: bool = False,  # rematerialize each layer in the backward pass
     return_kv: bool = True,  # False in training: don't stack per-layer K/V
-) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
-    """Returns (output, kv) where output is logits [B, T, V] (or values [B, T]
-    for critics) and kv stacks per-layer keys/values [n_layers, B, S, Hkv, Dh]
-    (S = T in packed mode, the cache length in decode mode).
+    return_aux: bool = False,  # also return MoE aux losses (layer means)
+):
+    """Returns (output, kv) — or (output, kv, aux) when ``return_aux`` —
+    where output is logits [B, T, V] (or values [B, T] for critics) and kv
+    stacks per-layer keys/values [n_layers, B, S, Hkv, Dh] (S = T in packed
+    mode, the cache length in decode mode). ``aux`` is a dict of MoE
+    balancing scalars averaged over layers ({} for dense models).
 
     Packed mode: ``segment_ids`` given, no cache — block-causal attention.
     Decode mode: ``kv_cache`` given — T is the new-token count (typically 1),
@@ -225,40 +286,59 @@ def forward(
     ``kv_valid`` cache slots.
     """
     decode = kv_cache is not None
-    h = constrain(params["embedding"][tokens], "hidden" if not decode else "hidden_decode")
+    h = params["embedding"][tokens]
+    if cfg.scale_embeddings:  # gemma normalizer
+        h = h * jnp.asarray(cfg.hidden_dim ** 0.5, h.dtype)
+    if cfg.pos_embedding == "learned":
+        h = h + params["pos_embedding"][positions]
+    h = constrain(h, "hidden" if not decode else "hidden_decode")
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rotary_base)
     layer_params = params["layers"]
 
     def body(h, xs):
         if decode:
             lp, (kc, vc) = xs
-            h2, (kc2, vc2) = _block(
+            h2, (kc2, vc2), aux = _block(
                 cfg, h, lp, cos, sin, None, None, (kc, vc),
                 cache_write_index, kv_valid, attn_impl,
             )
-            return h2, (kc2, vc2)
+            return h2, ((kc2, vc2), aux)
         lp = xs
-        h2, kv = _block(
+        h2, kv, aux = _block(
             cfg, h, lp, cos, sin, segment_ids, positions,
             None, None, None, attn_impl,
         )
-        return h2, (kv if return_kv else None)
+        return h2, ((kv if return_kv else None), aux)
 
     if remat and not decode:
         # HBM-for-FLOPs trade (the reference relies on Megatron activation
         # checkpointing; here it is one jax.checkpoint over the scan body).
         body = jax.checkpoint(body)
     if decode:
-        h, (ks, vs) = jax.lax.scan(
+        h, ((ks, vs), aux) = jax.lax.scan(
             body, h, (layer_params, (kv_cache["k"], kv_cache["v"]))
         )
-    elif return_kv:
-        h, (ks, vs) = jax.lax.scan(body, h, layer_params)
     else:
-        h, _ = jax.lax.scan(body, h, layer_params)
-        ks = vs = None
+        h, (kv, aux) = jax.lax.scan(body, h, layer_params)
+        ks, vs = kv if return_kv else (None, None)
+    # aux ys are stacked per-layer [n_layers]. The optimized total SUMS over
+    # layers (the reference's aux tracker accumulates every MoE layer's
+    # loss); the diagnostic stats are reported as layer means.
+    aux = (
+        {
+            k: (jnp.sum(v) if k == "aux_total" else jnp.mean(v))
+            for k, v in aux.items()
+        }
+        if aux is not None
+        else {}
+    )
 
-    h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
+    if cfg.norm_type == "layer":
+        h = layer_norm(
+            h, params["final_ln"], params["final_ln_b"], cfg.rms_norm_eps
+        )
+    else:
+        h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
     lg = "logits" if not decode else "logits_decode"
     if cfg.is_critic:
         out = (h @ params["value_head"])[..., 0]
@@ -266,7 +346,10 @@ def forward(
         out = constrain(h @ params["embedding"].T, lg)
     else:
         out = constrain(h @ params["lm_head"], lg)
-    return out, ({"k": ks, "v": vs} if ks is not None else None)
+    kv_out = {"k": ks, "v": vs} if ks is not None else None
+    if return_aux:
+        return out, kv_out, aux
+    return out, kv_out
 
 
 def init_kv_cache(
@@ -278,6 +361,21 @@ def init_kv_cache(
 
 def param_count(cfg: TransformerConfig) -> int:
     n, d, f, v = cfg.n_layers, cfg.hidden_dim, cfg.intermediate_dim, cfg.vocab_size
-    per_layer = d * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * d + 3 * d * f + 2 * d
+    attn = d * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * d
+    if cfg.moe is not None:
+        fr = cfg.moe.routed_intermediate_dim or f
+        mlp = cfg.moe.num_experts * 3 * d * fr + d * cfg.moe.num_experts
+        if cfg.moe.shared_intermediate_dim:
+            mlp += 3 * d * cfg.moe.shared_intermediate_dim
+    elif cfg.mlp_type == "plain":
+        mlp = 2 * d * f
+    else:
+        mlp = 3 * d * f
+    per_layer = attn + mlp + 2 * d
     head = d * v if not (cfg.tie_word_embeddings or cfg.is_critic) else 0
-    return v * d + n * per_layer + d + head + (d if cfg.is_critic else 0)
+    pos = (
+        cfg.max_position_embeddings * d
+        if cfg.pos_embedding == "learned"
+        else 0
+    )
+    return v * d + n * per_layer + d + head + pos + (d if cfg.is_critic else 0)

@@ -1,0 +1,141 @@
+"""Multi-host runtime: jax.distributed bootstrap + host-side broadcast.
+
+Parity target: ``realhf/impl/model/comm/global_comm.py:48`` (setup_global_comm
+— workers publish peer indices in name_resolve, rank 0 publishes the store
+address, torch.distributed joins) and ``realhf/apps/main.py:80`` (per-host
+worker launch). TPU-first shape: ONE trainer process per host joins a single
+SPMD program via ``jax.distributed.initialize``; ``jax.devices()`` then spans
+every host and one ``Mesh`` covers the pod. Control flow stays
+single-controller: rank 0 talks to the master/streams and broadcasts each
+(request, data) pair to the other ranks, which execute the same jitted steps
+in the same order (a GSPMD program must be dispatched identically on every
+process).
+
+CPU testing: each process sets ``--xla_force_host_platform_device_count=K``
+so N processes × K virtual devices form an N·K-device global mesh — the
+reference's gloo-on-CPU trick, JAX-style (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Optional
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("parallel.distributed")
+
+_INITIALIZED = False
+
+
+def coordinator_key(experiment: str, trial: str, group: str = "trainer") -> str:
+    return names.distributed_peer(experiment, trial, f"coordinator/{group}")
+
+
+def initialize(
+    experiment: str,
+    trial: str,
+    process_id: int,
+    num_processes: int,
+    group: str = "trainer",
+    local_device_count: Optional[int] = None,
+    timeout: float = 120.0,
+) -> None:
+    """Join the group's single SPMD program.
+
+    Rank 0 picks a free port and publishes ``ip:port`` under name_resolve
+    (the reference's rank-0 store publish, global_comm.py:60-75); other
+    ranks poll for it. No-op when num_processes == 1.
+    """
+    global _INITIALIZED
+    if num_processes <= 1 or _INITIALIZED:
+        return
+    import jax
+
+    key = coordinator_key(experiment, trial, group)
+    if process_id == 0:
+        addr = f"{network.gethostip()}:{network.find_free_port()}"
+        name_resolve.add(key, addr, replace=True)
+    else:
+        deadline = time.monotonic() + timeout
+        addr = None
+        while time.monotonic() < deadline:
+            try:
+                addr = name_resolve.get(key)
+                break
+            except Exception:  # noqa: BLE001 — not yet published
+                time.sleep(0.1)
+        if addr is None:
+            raise TimeoutError(f"no coordinator under {key}")
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=(
+            list(range(local_device_count)) if local_device_count else None
+        ),
+    )
+    _INITIALIZED = True
+    logger.info(
+        f"jax.distributed up: process {process_id}/{num_processes} "
+        f"coordinator {addr}, {jax.device_count()} global / "
+        f"{jax.local_device_count()} local devices"
+    )
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def broadcast_bytes(data: Optional[bytes]) -> bytes:
+    """Broadcast a byte string from process 0 to every process (length
+    first, then a padded buffer — non-source processes don't know the
+    size). Host-side collective over the global device set."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    if jax.process_count() == 1:
+        return data  # type: ignore[return-value]
+    src = jax.process_index() == 0
+    n = np.asarray([len(data) if src and data is not None else 0], np.int64)
+    n = int(mhu.broadcast_one_to_all(n)[0])
+    buf = np.zeros(n, np.uint8)
+    if src:
+        buf[:] = np.frombuffer(data, np.uint8)
+    buf = mhu.broadcast_one_to_all(buf)
+    return bytes(np.asarray(buf).tobytes())
+
+
+def broadcast_pyobj(obj: Any) -> Any:
+    """Pickle-broadcast an arbitrary host object from process 0 (the
+    reference broadcasts request payloads over its store; here it rides
+    the device fabric)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return obj
+    data = pickle.dumps(obj) if jax.process_index() == 0 else None
+    return pickle.loads(broadcast_bytes(data))
+
+
+def allgather_params(params: Any) -> Any:
+    """Gather a (possibly multi-host-sharded) param pytree to host numpy on
+    every process — used by checkpoint/HF-export paths where rank 0 writes.
+    Single-process: plain device_get. Multi-process: replicate through a
+    jitted identity (XLA all-gathers over ICI/DCN), then read locally."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    if jax.process_count() == 1:
+        return jax.device_get(params)
+    leaves = jax.tree_util.tree_leaves(params)
+    mesh = leaves[0].sharding.mesh
+    rep = NamedSharding(mesh, P())
+    out_shardings = jax.tree.map(lambda _: rep, params)
+    replicated = jax.jit(lambda x: x, out_shardings=out_shardings)(params)
+    return jax.device_get(replicated)

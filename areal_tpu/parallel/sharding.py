@@ -61,6 +61,14 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
     if cfg.use_qk_norm:
         layers["q_norm"] = P("pp", None)
         layers["k_norm"] = P("pp", None)
+    if cfg.norm_type == "layer":
+        layers["ln1_b"] = P("pp", None)
+        layers["ln2_b"] = P("pp", None)
+    if cfg.mlp_type == "plain" and cfg.moe is None:
+        layers["b_up"] = P("pp", "tp")
+        layers["b_down"] = P("pp", None)
+        for k in ("w_gate",):
+            layers.pop(k, None)
     if cfg.moe is not None:
         # Experts stack on a leading axis [n, E, ...]; shard E over the fsdp
         # axis (expert parallelism) and keep the ffn dim on tp.

@@ -189,6 +189,11 @@ class GenerationServer:
                 sampling_from_gconfigs([p.gconfig for p in group]),
                 n_tokens=chunk,
                 eos_token_id=cfg.eos_token_id, pad_token_id=cfg.pad_token_id,
+                # Rows with a smaller remaining budget than the batch chunk
+                # stop sampling at their own allowance.
+                row_budget=jnp.asarray(
+                    [min(p.max_tokens, chunk) for p in group], jnp.int32
+                ),
             )
             out = jax.device_get(out)
             for i, p in enumerate(group):

@@ -60,6 +60,10 @@ class GserverManager:
         self.running_rollouts = 0
         self.accepted_rollouts = 0  # trained samples submitted
         self._watcher_task = None
+        # Weight-sync latency bookkeeping (north-star metric #2).
+        self.last_sync_fanout_secs: Optional[float] = None
+        self.last_sync_e2e_secs: Optional[float] = None
+        self.sync_history: List[tuple] = []
 
     # ---------------- discovery ----------------
 
@@ -179,6 +183,22 @@ class GserverManager:
 
         return web.json_response({"version": self.version})
 
+    async def handle_metrics(self, request):
+        from aiohttp import web
+
+        hist = self.sync_history[-20:]
+        return web.json_response({
+            "version": self.version,
+            "running_rollouts": self.running_rollouts,
+            "accepted_rollouts": self.accepted_rollouts,
+            "weight_sync_fanout_secs": self.last_sync_fanout_secs,
+            "weight_sync_e2e_secs": self.last_sync_e2e_secs,
+            "weight_sync_history": [
+                {"version": v, "fanout_secs": f, "e2e_secs": e}
+                for v, f, e in hist
+            ],
+        })
+
     # ---------------- weight-update fanout ----------------
 
     async def _watch_weights(self):
@@ -204,9 +224,30 @@ class GserverManager:
                         for u in self.servers
                     ])
                 self.version = v
+                fanout_secs = time.monotonic() - t0
+                # End-to-end weight-sync latency (north-star metric #2,
+                # BASELINE.json): trainer save START → every server swapped.
+                # Requires loosely-synchronized host clocks across machines
+                # (same-host in local mode, NTP otherwise).
+                e2e_secs = None
+                try:
+                    pub_ts = float(name_resolve.get(
+                        names.model_version_time(
+                            self.cfg.experiment, self.cfg.trial,
+                            self.cfg.model_role,
+                        )
+                    ))
+                    e2e_secs = max(time.time() - pub_ts, fanout_secs)
+                except Exception:  # noqa: BLE001 — older trainers don't publish it
+                    pass
+                self.last_sync_fanout_secs = fanout_secs
+                self.last_sync_e2e_secs = e2e_secs
+                self.sync_history.append((v, fanout_secs, e2e_secs))
                 logger.info(
-                    f"fanned out weights v{v} to {len(self.servers)} servers "
-                    f"in {time.monotonic() - t0:.2f}s"
+                    f"weight sync v{v}: fanout {fanout_secs:.2f}s over "
+                    f"{len(self.servers)} servers"
+                    + (f", publish->swap {e2e_secs:.2f}s"
+                       if e2e_secs is not None else "")
                 )
                 self._gc_old_versions(v)
             await asyncio.sleep(self.cfg.weight_poll_secs)
@@ -235,6 +276,7 @@ class GserverManager:
         app.router.add_post("/allocate_rollout", self.handle_allocate_rollout)
         app.router.add_post("/finish_rollout", self.handle_finish_rollout)
         app.router.add_get("/get_model_version", self.handle_get_model_version)
+        app.router.add_get("/metrics", self.handle_metrics)
         return app
 
     async def start(self) -> str:

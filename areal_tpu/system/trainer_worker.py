@@ -25,6 +25,7 @@ import dataclasses
 import os
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -79,6 +80,17 @@ class TrainerWorkerConfig:
     # async mode: pull trajectories from rollout workers instead of a dataset
     stream_dataset: bool = False
     realloc_dir: str = "/tmp/areal_tpu/realloc"
+    # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
+    # one per host — join one jax.distributed program; rank 0 owns every
+    # control-plane socket and broadcasts (request, data) to the others,
+    # which execute the same jitted steps in the same order.
+    dist_rank: int = 0
+    dist_world: int = 1
+    # Virtual CPU devices per process for multi-process CPU testing.
+    dist_local_devices: Optional[int] = None
+    # TPU chip ids this worker may initialize (launcher-assigned partition
+    # in decoupled async mode); None = all chips.
+    chips: Optional[List[int]] = None
 
 
 class TrainerWorker:
@@ -128,8 +140,26 @@ class TrainerWorker:
             return Model(role, None)
         raise ValueError(f"role {role}: no model source in init={rc.init}")
 
+    @property
+    def _rank0(self) -> bool:
+        return self.cfg.dist_rank == 0
+
+    def _bcast(self, obj):
+        if self.cfg.dist_world > 1:
+            from areal_tpu.parallel import distributed as dist
+
+            return dist.broadcast_pyobj(obj)
+        return obj
+
     def setup(self) -> None:
         cfg = self.cfg
+        if cfg.dist_world > 1:
+            from areal_tpu.parallel import distributed as dist
+
+            dist.initialize(
+                cfg.experiment, cfg.trial, cfg.dist_rank, cfg.dist_world,
+                group="trainer", local_device_count=cfg.dist_local_devices,
+            )
         for role, rc in cfg.models.items():
             model = self._model_factory(role, rc)
             if model.tokenizer is None:
@@ -144,22 +174,26 @@ class TrainerWorker:
             self.interfaces[mfc_name] = make_interface(
                 mc.interface, **mc.interface_args
             )
-        if cfg.dataset is not None:
+        # Rank 0 owns the data plane and the master's request socket; other
+        # ranks receive everything via broadcast.
+        if cfg.dataset is not None and self._rank0:
             self._dataset = make_dataset(
                 cfg.dataset, tokenizer=cfg.tokenizer, **cfg.dataset_args
             )
             self._reshuffle()
-        if cfg.stream_dataset:
+        if cfg.stream_dataset and self._rank0:
             self._puller = ZmqPuller(cfg.experiment, cfg.trial, cfg.handler)
             self._pull_thread = threading.Thread(
                 target=self._pull_loop, daemon=True
             )
             self._pull_thread.start()
-        self._server = WorkerRequestServer(
-            cfg.experiment, cfg.trial, cfg.handler
-        )
+        if self._rank0:
+            self._server = WorkerRequestServer(
+                cfg.experiment, cfg.trial, cfg.handler
+            )
         logger.info(
-            f"trainer up: models={list(self.models)} mfcs={list(self.interfaces)}"
+            f"trainer up (rank {cfg.dist_rank}/{cfg.dist_world}): "
+            f"models={list(self.models)} mfcs={list(self.interfaces)}"
         )
 
     def _reshuffle(self):
@@ -175,8 +209,8 @@ class TrainerWorker:
 
     # ---------------- handlers ----------------
 
-    def _handle_fetch(self, p: Payload) -> Any:
-        n = int(p.data or self.cfg.batch_size)
+    def _read_batch(self, n: int) -> SequenceSample:
+        """Rank-0-only data-plane read (dataset or rollout stream)."""
         if self.cfg.stream_dataset:
             out: List[SequenceSample] = []
             while len(out) < n:
@@ -186,19 +220,27 @@ class TrainerWorker:
                     if out:
                         break  # partial batch is fine in async mode
                     continue
-            batch = SequenceSample.gather(out)
-        else:
-            idx = []
-            while len(idx) < n and self._dataset is not None:
-                if self._epoch_pos >= len(self._data_iter):
-                    self._epoch += 1
-                    self._reshuffle()
-                idx.append(self._data_iter[self._epoch_pos])
-                self._epoch_pos += 1
-            batch = SequenceSample.gather([self._dataset[i] for i in idx])
+            return SequenceSample.gather(out)
+        idx = []
+        while len(idx) < n and self._dataset is not None:
+            if self._epoch_pos >= len(self._data_iter):
+                self._epoch += 1
+                self._reshuffle()
+            idx.append(self._data_iter[self._epoch_pos])
+            self._epoch_pos += 1
+        return SequenceSample.gather([self._dataset[i] for i in idx])
+
+    def _store_batch(self, batch: SequenceSample) -> None:
         for i in range(batch.bs):
             s = batch.select_idx([i])
             self.store[s.ids[0]] = s
+
+    def _handle_fetch(self, p: Payload) -> Any:
+        batch = self._read_batch(int(p.data or self.cfg.batch_size))
+        # Every rank stores the same batch (multi-host: the jitted steps
+        # consume identical replicated host inputs on each process).
+        self._bcast(("fetch", batch))
+        self._store_batch(batch)
         return {
             "meta": batch.meta(),
             "epoch": self._epoch,
@@ -281,14 +323,16 @@ class TrainerWorker:
             raise ValueError(f"unknown hook {hook}")
 
     def _save_role(self, role: str, path: str) -> None:
-        import jax
-
         from areal_tpu.models import hf as hfmod
+        from areal_tpu.parallel import distributed as dist
 
         model = self.models[role]
         engine = model.module
+        host_params = dist.allgather_params(engine.params)
+        if not self._rank0:
+            return
         hfmod.save_hf_checkpoint(
-            jax.device_get(engine.params), engine.cfg, path,
+            host_params, engine.cfg, path,
             meta={"version": model.version.global_step},
         )
 
@@ -298,12 +342,27 @@ class TrainerWorker:
         model = self.models[role]
         version = model.version.global_step
         path = os.path.join(self.cfg.realloc_dir, role, str(version))
+        t0 = time.monotonic()
         self._save_role(role, path)
+        save_secs = time.monotonic() - t0
+        if not self._rank0:
+            return
+        # Publish time anchors the end-to-end weight-sync latency metric
+        # (save start → every server swapped; GserverManager reads it).
+        name_resolve.add(
+            names.model_version_time(
+                self.cfg.experiment, self.cfg.trial, role
+            ),
+            repr(time.time() - save_secs), replace=True,
+        )
         name_resolve.add(
             names.model_version(self.cfg.experiment, self.cfg.trial, role),
             str(version), replace=True,
         )
-        logger.info(f"published {role} weights v{version} -> {path}")
+        logger.info(
+            f"published {role} weights v{version} -> {path} "
+            f"(save {save_secs:.2f}s)"
+        )
 
     def _handle_model_info(self) -> Dict[str, Any]:
         """Model geometry + device info for the master's FLOPs/MFU logging
@@ -352,15 +411,19 @@ class TrainerWorker:
         import json
 
         ckpt_dir = p.data["dir"]
-        os.makedirs(ckpt_dir, exist_ok=True)
+        if self._rank0:
+            os.makedirs(ckpt_dir, exist_ok=True)
         meta: Dict[str, Any] = {
             "versions": {}, "epoch": self._epoch, "epoch_pos": self._epoch_pos,
         }
         for role, model in self.models.items():
             engine = model.module
             if hasattr(engine, "save_train_state"):
+                # Multi-host: all ranks join the gather; rank 0 writes.
                 engine.save_train_state(os.path.join(ckpt_dir, role))
             meta["versions"][role] = model.version.global_step
+        if not self._rank0:
+            return {"ok": True}
         iface_states = {}
         for mfc_name, iface in self.interfaces.items():
             if hasattr(iface, "state_dict"):
@@ -400,10 +463,8 @@ class TrainerWorker:
 
     # ---------------- loop ----------------
 
-    def serve_once(self, timeout_ms: int = 100) -> bool:
-        p = self._server.poll(timeout_ms)
-        if p is None:
-            return False
+    def _dispatch(self, p: Payload) -> None:
+        """Execute one request (all ranks run this identically)."""
         try:
             if p.handle_name == "fetch":
                 p.output = self._handle_fetch(p)
@@ -431,13 +492,47 @@ class TrainerWorker:
 
             p.exception = f"{e}\n{traceback.format_exc()}"
             logger.error(f"handler {p.handle_name} failed: {p.exception}")
+
+    def serve_once(self, timeout_ms: int = 100) -> bool:
+        p = self._server.poll(timeout_ms)
+        if p is None:
+            return False
+        if p.handle_name != "fetch":
+            # _handle_fetch broadcasts its own (request, batch) pair after
+            # the rank-0-only data read; everything else replays verbatim.
+            self._bcast(("cmd", p.handle_name, p.data, p.mb_spec,
+                         p.pre_hooks, p.post_hooks))
+        self._dispatch(p)
         self._server.reply(p)
         return True
 
+    def _follow_once(self) -> None:
+        """Rank > 0: receive one broadcast command and replay it."""
+        from areal_tpu.parallel import distributed as dist
+
+        msg = dist.broadcast_pyobj(None)
+        if msg[0] == "fetch":
+            self._store_batch(msg[1])
+            return
+        _, handle_name, data, mb_spec, pre, post = msg
+        p = Payload(handler=self.cfg.handler, handle_name=handle_name,
+                    data=data, mb_spec=mb_spec, pre_hooks=pre,
+                    post_hooks=post)
+        self._dispatch(p)
+        if p.exception:
+            raise RuntimeError(
+                f"rank {self.cfg.dist_rank} replay of {handle_name} failed: "
+                f"{p.exception}"
+            )
+
     def run(self) -> None:
         self.setup()
-        while not self._exiting:
-            self.serve_once(timeout_ms=100)
+        if self._rank0:
+            while not self._exiting:
+                self.serve_once(timeout_ms=100)
+        else:
+            while not self._exiting:
+                self._follow_once()
         if self._server:
             self._server.close()
         if self._puller:

@@ -6,9 +6,10 @@ Protocol (mirrors the reference's "effective trained tokens/sec",
 benchmark/verl_v0_3_0_post1_76084d3/README.md:27-34): time full PPO actor
 train steps — micro-batched forward+backward+optimizer over packed
 variable-length trajectories — and divide the trajectory token count by
-wall clock. Model: Qwen2.5-1.5B-shaped config (BASELINE.json config #1) in
-bf16. vs_baseline is measured/analytic-roofline (MFU proxy) since the
-reference publishes no absolute tokens/sec (BASELINE.md).
+wall clock. Model: Qwen2.5-0.5B geometry (the largest BASELINE-family model
+whose params+Adam+logits fit one 16G chip) in bf16. vs_baseline is
+measured/analytic-roofline (MFU proxy) since the reference publishes no
+absolute tokens/sec (BASELINE.md).
 """
 
 import json
@@ -46,7 +47,9 @@ def main():
         optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant",
                                   warmup_steps_proportion=0.0),
         compute_dtype="bfloat16", length_bucket=512, rows_bucket=4,
-        seqs_bucket=16, remat=True,
+        # 0.5B in bf16 fits without activation checkpointing; remat costs
+        # ~25% extra FLOPs and is only needed for larger configs.
+        seqs_bucket=16, remat=False,
     )
     model = backend.initialize(model, FinetuneSpec(1, 512, 64))
 
@@ -92,6 +95,32 @@ def main():
     n_chips = jax.device_count()
     tokens_per_sec_chip = steps * total / dt / n_chips
 
+    # North-star metric #2 (BASELINE.json): trainer→rollout weight-sync
+    # latency. Measured as the full disk path on this chip: sharded
+    # safetensors save → threaded load → device_put swap (what
+    # trainer_worker.publish_weights + generation_server /update_weights do).
+    import shutil
+    import tempfile
+
+    from areal_tpu.models import hf as hfmod
+
+    eng = model.module
+    sync_dir = tempfile.mkdtemp(prefix="areal_sync_")
+    try:
+        t0 = time.perf_counter()
+        hfmod.save_hf_checkpoint(jax.device_get(eng.params), cfg, sync_dir)
+        _, loaded = hfmod.load_hf_checkpoint(sync_dir)
+        new_params = jax.tree.map(
+            lambda old, npv: jax.device_put(
+                np.asarray(npv, dtype=old.dtype), old.sharding
+            ),
+            eng.params, loaded,
+        )
+        jax.block_until_ready(new_params)
+        weight_sync_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(sync_dir, ignore_errors=True)
+
     # Roofline context: analytic train FLOPs (6·N·T, llama formula family —
     # reference realhf/base/monitor.py:288) over the bf16 peak of one chip.
     n_params = transformer.param_count(cfg)
@@ -109,6 +138,7 @@ def main():
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
+        "weight_sync_latency_s": round(weight_sync_s, 3),
     }))
 
 

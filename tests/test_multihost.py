@@ -1,0 +1,138 @@
+"""Multi-host runtime tests: two jax.distributed processes × 4 virtual CPU
+devices jointly execute the PPO actor train step over one global 8-device
+mesh and must reproduce the single-process loss (reference analogue:
+multi-process gloo tests via LocalMultiProcessTest, testing.py:137)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import json, os, sys
+import numpy as np
+
+rank = int(sys.argv[1]); world = int(sys.argv[2]); nr_dir = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+    + os.environ.get("NDEV", "4"))
+sys.path.insert(0, os.environ["REPO"])
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from areal_tpu.base import name_resolve
+name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_dir)
+
+from areal_tpu.parallel import distributed as dist
+dist.initialize("mh", "t", rank, world, group="test", local_device_count=None)
+assert jax.device_count() == 8, jax.device_count()
+assert jax.process_count() == world
+
+# Broadcast check: follower receives rank 0's object.
+obj = dist.broadcast_pyobj({"batch_seed": 7} if rank == 0 else None)
+assert obj == {"batch_seed": 7}
+
+from areal_tpu.algorithms.ppo import PPOActorInterface, PPOHyperparameters
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import FinetuneSpec, Model
+from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+from areal_tpu.parallel import mesh as pmesh
+
+mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
+cfg = tiny_config(vocab_size=128)
+params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+model = Model("actor", (cfg, params))
+backend = JaxTrainBackend(
+    optimizer=OptimizerConfig(lr=1e-4, lr_scheduler_type="constant",
+                              warmup_steps_proportion=0.0),
+    mesh=mesh, compute_dtype="float32", length_bucket=16, rows_bucket=2,
+    seqs_bucket=4,
+)
+model = backend.initialize(model, FinetuneSpec(1, 16, 8))
+iface = PPOActorInterface(PPOHyperparameters(
+    ppo_n_minibatches=1, disable_value=True, kl_ctl=0.0))
+
+rng = np.random.RandomState(obj["batch_seed"])
+n_seq = 8
+plens = rng.randint(3, 6, n_seq); glens = rng.randint(4, 9, n_seq)
+seqlens = (plens + glens).astype(int); total = int(seqlens.sum())
+pmask = np.concatenate([
+    np.concatenate([np.ones(p, np.int32), np.zeros(g, np.int32)])
+    for p, g in zip(plens, glens)])
+batch = SequenceSample.from_default(
+    ids=[f"d{i}" for i in range(n_seq)],
+    data={
+        "packed_input_ids": rng.randint(2, 128, total).astype(np.int32),
+        "prompt_mask": pmask,
+        "packed_logprobs": np.where(pmask == 0, -1.0, 0.0).astype(np.float32),
+        "rewards": rng.rand(n_seq).astype(np.float32),
+        "seq_no_eos_mask": np.zeros(n_seq, np.float32),
+    },
+    seqlens=seqlens.tolist(),
+)
+stats = iface.train_step(model, batch, MicroBatchSpec())
+
+# Checkpoint collective: every rank gathers, rank 0 writes.
+ck = os.path.join(nr_dir, "ck")
+model.module.save_train_state(ck)
+if rank == 0:
+    assert os.path.exists(os.path.join(ck, "params.npz"))
+    print("RESULT " + json.dumps({"loss": stats["actor_loss"]}))
+"""
+
+
+@pytest.mark.timeout(300)
+def test_two_process_spmd_matches_single(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(world):
+        procs = []
+        for r in range(world):
+            env = dict(
+                os.environ, REPO=repo,
+                JAX_PLATFORMS="cpu",
+                NDEV=str(8 // world),
+                XLA_FLAGS=f"--xla_force_host_platform_device_count={8 // world}",
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script), str(r), str(world),
+                 str(tmp_path / f"nr{world}")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            ))
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for p, o in zip(procs, outs):
+            assert p.returncode == 0, o[-3000:]
+        line = [ln for ln in outs[0].splitlines() if ln.startswith("RESULT ")]
+        assert line, outs[0][-3000:]
+        return json.loads(line[0][len("RESULT "):])
+
+    one = run(1)
+    two = run(2)
+    assert two["loss"] == pytest.approx(one["loss"], abs=1e-5)
+
+
+def test_chip_assignment_math():
+    from areal_tpu.apps.launcher import derive_chip_assignment
+
+    # Sync / no allocation mode: trainer owns every chip.
+    assert derive_chip_assignment("", 4) == {
+        "trainer": [0, 1, 2, 3], "gen": []}
+    assert derive_chip_assignment("d2t2", 4) == {
+        "trainer": [0, 1, 2, 3], "gen": []}
+    # Decoupled: disjoint partitions.
+    asg = derive_chip_assignment("gen.d2+d2t2", 8)
+    assert asg == {"trainer": [0, 1, 2, 3], "gen": [4, 5]}
+    assert not set(asg["trainer"]) & set(asg["gen"])
+    # Impossible layout fails fast with an actionable message.
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="1 trainer \\+ 1 generation"):
+        derive_chip_assignment("gen.d1+d1", 1)

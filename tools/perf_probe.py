@@ -1,0 +1,215 @@
+"""Perf probe for the bench workload: isolates device kernel time from host
+dispatch/packing overhead and sweeps the knobs that plausibly gate MFU.
+
+Usage: python tools/perf_probe.py [probe ...]
+Probes: e2e, grad, mbsweep, remat, trace  (default: e2e grad)
+
+Writes findings to stdout; `trace` saves a jax.profiler trace under
+profiles/ for offline inspection.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(remat=True, length_bucket=512, rows_bucket=4, seqs_bucket=16,
+          attn_impl="auto"):
+    from areal_tpu.algorithms.ppo import PPOActorInterface, PPOHyperparameters
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import FinetuneSpec, Model
+    from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import TransformerConfig
+
+    cfg = TransformerConfig(
+        n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
+        intermediate_dim=4864, vocab_size=151936, rotary_base=1e6,
+        tie_word_embeddings=True, use_attention_bias=True, dtype="bfloat16",
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    model = Model("actor", (cfg, params), tokenizer=None)
+    backend = JaxTrainBackend(
+        optimizer=OptimizerConfig(lr=1e-5, lr_scheduler_type="constant",
+                                  warmup_steps_proportion=0.0),
+        compute_dtype="bfloat16", length_bucket=length_bucket,
+        rows_bucket=rows_bucket, seqs_bucket=seqs_bucket, remat=remat,
+        attn_impl=attn_impl,
+    )
+    model = backend.initialize(model, FinetuneSpec(1, 512, 64))
+    hp = PPOHyperparameters(ppo_n_minibatches=1, adv_norm=True,
+                            kl_ctl=0.0, disable_value=True)
+    iface = PPOActorInterface(hp)
+
+    rng = np.random.RandomState(0)
+    n_seq = 32
+    plens = rng.randint(200, 257, n_seq)
+    glens = rng.randint(512, 769, n_seq)
+    seqlens = (plens + glens).astype(int)
+    total = int(seqlens.sum())
+    toks = rng.randint(2, cfg.vocab_size, total).astype(np.int32)
+    pmask, lps = [], []
+    for p, g in zip(plens, glens):
+        pmask.append(np.concatenate([np.ones(p, np.int32), np.zeros(g, np.int32)]))
+        lps.append(np.concatenate([np.zeros(p, np.float32),
+                                   -rng.rand(g).astype(np.float32)]))
+    batch = SequenceSample.from_default(
+        ids=[f"b{i}" for i in range(n_seq)],
+        data={
+            "packed_input_ids": toks,
+            "prompt_mask": np.concatenate(pmask),
+            "packed_logprobs": np.concatenate(lps),
+            "rewards": rng.rand(n_seq).astype(np.float32),
+            "seq_no_eos_mask": np.zeros(n_seq, np.float32),
+        },
+        seqlens=seqlens.tolist(),
+    )
+    return cfg, model, iface, batch, total
+
+
+PEAK = 197e12  # v5e bf16
+
+
+def report(tag, total, dt, steps, cfg_nparams, remat):
+    tps = steps * total / dt
+    mfu = 6.0 * cfg_nparams * total * steps / dt / PEAK
+    print(f"[{tag}] {tps:,.0f} tok/s  step={dt/steps*1e3:.0f}ms  "
+          f"MFU(6N)={mfu:.3f}", flush=True)
+
+
+def main():
+    probes = sys.argv[1:] or ["e2e", "grad"]
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.backend import microbatch as mbu
+    from areal_tpu.models import transformer
+
+    spec = MicroBatchSpec(max_tokens_per_mb=4096)
+
+    if "e2e" in probes or "grad" in probes or "trace" in probes:
+        cfg, model, iface, batch, total = build()
+        nparams = transformer.param_count(cfg)
+        eng = model.module
+        iface.train_step(model, batch, spec)  # compile
+        jax.block_until_ready(eng.params)
+
+        if "e2e" in probes:
+            t0 = time.perf_counter()
+            for _ in range(3):
+                iface.train_step(model, batch, spec)
+            jax.block_until_ready(eng.params)
+            report("e2e remat=T mb=4096", total, time.perf_counter() - t0, 3,
+                   nparams, True)
+
+        if "grad" in probes or "trace" in probes:
+            # Device-only: one microbatch's grad step, timed in a tight loop
+            # with a single final sync → pure kernel throughput.
+            from areal_tpu.algorithms import ppo as ppomod
+            extra = ppomod.compute_advantages_and_returns(batch, iface.hp, 0.0)
+            extra.pop("_mean_kl")
+            b2 = ppomod.attach_keys(batch, extra)
+            ppomod.normalize_advantages(b2, iface.hp)
+            mbs = mbu.split_into_microbatches(
+                b2, spec, length_bucket=512, rows_bucket=4, seqs_bucket=16)
+            gfn = eng._get_grad_fn(iface._loss_fn, with_carry=False)
+            dbs = [eng._device_batch(mb) for mb in mbs]
+            ntok = sum(mb.n_tokens for mb in mbs)
+            ncells = sum(int(np.prod(mb.grids["tokens"].shape)) for mb in mbs)
+            print(f"[pack] {len(mbs)} mbs, fill={ntok/ncells:.2f} "
+                  f"({ntok} tok / {ncells} cells)", flush=True)
+            denom = jnp.asarray(1000.0, jnp.float32)
+            one = jnp.asarray(1.0, jnp.float32)
+            for db in dbs:
+                gfn(eng.params, db, denom, one, one)  # compile each shape
+            jax.block_until_ready(eng.params)
+
+            if "grad" in probes:
+                t0 = time.perf_counter()
+                outs = None
+                for _ in range(3):
+                    for db in dbs:
+                        outs = gfn(eng.params, db, denom, one, one)
+                jax.block_until_ready(outs)
+                report("grad-only (fwd+bwd, no opt)", ntok,
+                       time.perf_counter() - t0, 3, nparams, True)
+
+            if "trace" in probes:
+                import os
+                os.makedirs("profiles", exist_ok=True)
+                with jax.profiler.trace("profiles/bench_step"):
+                    iface.train_step(model, batch, spec)
+                    jax.block_until_ready(eng.params)
+                print("[trace] saved to profiles/bench_step", flush=True)
+
+    if "phases" in probes:
+        cfg, model, iface, batch, total = build()
+        eng = model.module
+        iface.train_step(model, batch, spec)
+        jax.block_until_ready(eng.params)
+        from areal_tpu.algorithms import ppo as ppomod
+        t = {}
+        for _ in range(3):
+            t0 = time.perf_counter()
+            extra = ppomod.compute_advantages_and_returns(batch, iface.hp, 0.0)
+            extra.pop("_mean_kl")
+            b2 = ppomod.attach_keys(batch, extra)
+            ppomod.normalize_advantages(b2, iface.hp)
+            t["adv+norm"] = t.get("adv+norm", 0) + time.perf_counter() - t0
+            t0 = time.perf_counter()
+            mbs = mbu.split_into_microbatches(
+                b2, spec, length_bucket=512, rows_bucket=4, seqs_bucket=16)
+            t["split+pack"] = t.get("split+pack", 0) + time.perf_counter() - t0
+            t0 = time.perf_counter()
+            dbs = [eng._device_batch(mb) for mb in mbs]
+            t["transfer"] = t.get("transfer", 0) + time.perf_counter() - t0
+            gfn = eng._get_grad_fn(iface._loss_fn, with_carry=False)
+            t0 = time.perf_counter()
+            denom = jnp.asarray(1000.0, jnp.float32)
+            one = jnp.asarray(1.0, jnp.float32)
+            o = None
+            ga = None
+            for db in dbs:
+                loss, stats, grads = gfn(eng.params, db, denom, one, one)
+                ga = grads if ga is None else jax.tree.map(jnp.add, ga, grads)
+                o = loss
+            jax.block_until_ready(o)
+            t["grad+acc"] = t.get("grad+acc", 0) + time.perf_counter() - t0
+            t0 = time.perf_counter()
+            jax.block_until_ready(ga)
+            t["acc_drain"] = t.get("acc_drain", 0) + time.perf_counter() - t0
+        for k, v in t.items():
+            print(f"[phase] {k}: {v/3*1e3:.0f}ms", flush=True)
+
+    if "remat" in probes:
+        cfg, model, iface, batch, total = build(remat=False)
+        nparams = transformer.param_count(cfg)
+        iface.train_step(model, batch, spec)
+        jax.block_until_ready(model.module.params)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            iface.train_step(model, batch, spec)
+        jax.block_until_ready(model.module.params)
+        report("e2e remat=F mb=4096", total, time.perf_counter() - t0, 3,
+               nparams, False)
+
+    if "mbsweep" in probes:
+        for cap in (8192, 16384, 32768):
+            cfg, model, iface, batch, total = build()
+            nparams = transformer.param_count(cfg)
+            sp = MicroBatchSpec(max_tokens_per_mb=cap)
+            iface.train_step(model, batch, sp)
+            jax.block_until_ready(model.module.params)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                iface.train_step(model, batch, sp)
+            jax.block_until_ready(model.module.params)
+            report(f"e2e remat=T mb={cap}", total, time.perf_counter() - t0,
+                   3, nparams, True)
+
+
+if __name__ == "__main__":
+    main()
