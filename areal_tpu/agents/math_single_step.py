@@ -31,7 +31,9 @@ class MathCodeSingleStepEnv(EnvironmentService):
 
     async def step(self, action: Tuple[str, List[str]]):
         qid, texts = action
-        info = self.id2info.get(str(qid).rsplit("@", 1)[0], {})
+        # ids carry "@"-separated suffixes (group index, epoch-pass tag);
+        # the dataset key is everything before the first "@".
+        info = self.id2info.get(str(qid).split("@", 1)[0], {})
         kind = info.get("task", "math")
         tasks = []
         for t in texts:

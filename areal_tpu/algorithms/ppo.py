@@ -414,7 +414,14 @@ class PPOActorInterface(ModelInterface):
             # one apply and ONE host sync per PPO minibatch (critical
             # through a remote-device transport; also the best pipelining
             # locally).
-            ub = engine.upload_uniform(data, mb_spec)
+            # Request at least ppo_n_minibatches micro-batches from the
+            # packer: with the default MicroBatchSpec the whole batch packs
+            # into ONE uniform micro-batch, which would silently collapse
+            # the PPO minibatch loop (reference ppo_interface.py:698) to a
+            # single optimizer step.
+            ub = engine.upload_uniform(data, dataclasses.replace(
+                mb_spec, n_mbs=max(mb_spec.n_mbs or 1, hp.ppo_n_minibatches)
+            ))
             scalars = engine.run_prep(
                 ub, self._prep_fn, self._prep_fn,
                 scalars={"kl_coef": self.kl_ctl.value},
@@ -499,6 +506,7 @@ class PPOActorInterface(ModelInterface):
             "grad_norm": agg.get("grad_norm", 0.0) / max(n_steps, 1),
             "lr": agg.get("lr", 0.0) / max(n_steps, 1),
             "n_action_tokens": agg.get("n_action_tokens", 0.0),
+            "n_ppo_steps": float(n_steps),
             "task_reward": float(np.mean(np.asarray(data.data["rewards"]))),
         }
 

@@ -43,7 +43,9 @@ class MultiTaskRewardInterface(ModelInterface):
         self.id2info = self.id2info or {}
 
     def _lookup(self, sample_id: Hashable) -> Dict[str, Any]:
-        qid = str(sample_id).rsplit("@", 1)[0]
+        # ids carry "@"-separated suffixes (group index, epoch-pass tag);
+        # the dataset key is everything before the first "@".
+        qid = str(sample_id).split("@", 1)[0]
         return self.id2info.get(qid, {})
 
     def inference(

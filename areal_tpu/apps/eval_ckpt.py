@@ -1,0 +1,116 @@
+"""Offline checkpoint evaluation harness.
+
+Parity target: the reference's ``evaluation/`` harness as driven by
+``realhf/scheduler/evaluator.py`` (one subprocess per saved checkpoint:
+generate on a benchmark set, grade, emit scores). The reference vendors a
+51k-LoC latex2sympy stack and uses vLLM; here the same framework that
+trains also evaluates: checkpoints load through ``models/hf.py``, greedy
+(or sampled) generation runs through ``models/generate.py`` on whatever
+platform this process owns, and grading uses ``rewards/math_verify.py``.
+
+Usage:
+    python -m areal_tpu.apps.eval_ckpt --ckpt <hf_dir> --dataset <jsonl> \
+        --output scores.json [--max-gen-tokens 512] [--mock-tokenizer]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("apps.eval")
+
+
+def evaluate_checkpoint(
+    ckpt_dir: str,
+    dataset_path: str,
+    max_gen_tokens: int = 512,
+    batch_size: int = 16,
+    mock_tokenizer: bool = False,
+    limit: Optional[int] = None,
+) -> dict:
+    import jax
+
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.datasets.jsonl import load_jsonl
+    from areal_tpu.models import generate as G
+    from areal_tpu.models import hf as hfmod
+    from areal_tpu.rewards.math_verify import verify_math
+
+    cfg, params = hfmod.load_hf_checkpoint(ckpt_dir)
+    if mock_tokenizer:
+        from areal_tpu.base.testing import MockTokenizer
+
+        tok = MockTokenizer()
+    else:
+        import transformers
+
+        tok = transformers.AutoTokenizer.from_pretrained(ckpt_dir)
+    records = load_jsonl(dataset_path)
+    if limit:
+        records = records[:limit]
+    eos = getattr(tok, "eos_token_id", None) or 1
+    pad = getattr(tok, "pad_token_id", None) or eos
+    gconfig = GenerationHyperparameters(greedy=True)
+    n_correct, n_total = 0, 0
+    t0 = time.time()
+    for i in range(0, len(records), batch_size):
+        chunk = records[i : i + batch_size]
+        prompt_list: List[List[int]] = [
+            list(map(int, tok.encode(r["prompt"]))) for r in chunk
+        ]
+        prompts, plens = G.pad_prompts(prompt_list, pad)
+        out = G.generate_batch(
+            params, cfg, prompts, plens,
+            key=jax.random.PRNGKey(0),
+            gconfig=gconfig,
+            max_new_tokens=max_gen_tokens,
+            eos_token_id=eos,
+            pad_token_id=pad,
+        )
+        out_ids = np.asarray(out["output_ids"])
+        out_lens = np.asarray(out["output_lens"])
+        for rec, toks, n in zip(chunk, out_ids, out_lens):
+            text = tok.decode(list(map(int, toks[: int(n)])))
+            score = verify_math(text, rec.get("solutions", []))
+            n_correct += int(score > 0)
+            n_total += 1
+    return {
+        "ckpt": ckpt_dir,
+        "dataset": dataset_path,
+        "n": n_total,
+        "accuracy": n_correct / max(n_total, 1),
+        "eval_secs": round(time.time() - t0, 2),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--dataset", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--max-gen-tokens", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--limit", type=int, default=None)
+    ap.add_argument("--mock-tokenizer", action="store_true")
+    args = ap.parse_args(argv)
+    result = evaluate_checkpoint(
+        args.ckpt, args.dataset,
+        max_gen_tokens=args.max_gen_tokens,
+        batch_size=args.batch_size,
+        mock_tokenizer=args.mock_tokenizer,
+        limit=args.limit,
+    )
+    with open(args.output, "w") as f:
+        json.dump(result, f)
+    logger.info(f"eval done: {result}")
+
+
+if __name__ == "__main__":
+    main()

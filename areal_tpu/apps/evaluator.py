@@ -1,0 +1,161 @@
+"""Automatic checkpoint evaluator.
+
+Parity target: ``realhf/scheduler/evaluator.py:160`` (AutomaticEvaluator +
+EvaluationStep): a watcher thread scans the experiment's persistent save
+directory for new checkpoints, runs at most ``max_concurrent_jobs`` eval
+subprocesses (``apps/eval_ckpt.py``) over them in step order, and logs the
+returned scores through the metric writer (wandb/tensorboard).
+
+Consumes ``BaseExperimentConfig.auto_eval`` / ``auto_eval_config`` — the
+launcher starts one evaluator when ``auto_eval=True``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("apps.evaluator")
+
+_STEP_DIR = re.compile(r"^step(\d+)$")
+
+
+@dataclasses.dataclass
+class EvaluationStep:
+    """One checkpoint's eval lifecycle (reference evaluator.py:34)."""
+
+    step: int
+    ckpt_dir: str
+    status: str = "pending"  # pending | running | done | failed
+    scores: Optional[Dict] = None
+
+
+def discover_new_steps(
+    save_dir: str, role: str, seen: set
+) -> List[EvaluationStep]:
+    root = os.path.join(save_dir, role)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _STEP_DIR.match(name)
+        if not m or name in seen:
+            continue
+        d = os.path.join(root, name)
+        # Only pick up completed saves (config.json is written last).
+        if os.path.exists(os.path.join(d, "config.json")):
+            seen.add(name)
+            out.append(EvaluationStep(step=int(m.group(1)), ckpt_dir=d))
+    return sorted(out, key=lambda s: s.step)
+
+
+class AutomaticEvaluator:
+    """Watch → evaluate → log. The eval command is injectable for tests;
+    the default spawns ``python -m areal_tpu.apps.eval_ckpt``."""
+
+    def __init__(
+        self,
+        cfg,  # AutomaticEvaluatorConfig
+        save_dir: str,
+        dataset_path: str,
+        role: str = "actor",
+        metric_writer=None,
+        run_eval: Optional[Callable[[EvaluationStep], Dict]] = None,
+        poll_secs: float = 5.0,
+        mock_tokenizer: bool = False,
+    ):
+        self.cfg = cfg
+        self.save_dir = save_dir
+        self.dataset_path = dataset_path
+        self.role = role
+        self.writer = metric_writer
+        self.poll_secs = poll_secs
+        self.mock_tokenizer = mock_tokenizer
+        self._run_eval = run_eval or self._subprocess_eval
+        self._seen: set = set()
+        self.steps: List[EvaluationStep] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------- eval execution --------------
+
+    def _subprocess_eval(self, step: EvaluationStep) -> Dict:
+        with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+            out_path = f.name
+        cmd = [
+            sys.executable, "-m", "areal_tpu.apps.eval_ckpt",
+            "--ckpt", step.ckpt_dir,
+            "--dataset", self.dataset_path,
+            "--output", out_path,
+            "--max-gen-tokens", str(self.cfg.max_gen_tokens),
+        ]
+        if self.mock_tokenizer:
+            cmd.append("--mock-tokenizer")
+        env = dict(os.environ)
+        # Eval shares the host with training: keep it off the TPU.
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                               timeout=3600)
+            if r.returncode != 0:
+                raise RuntimeError(r.stderr[-800:])
+            with open(out_path) as f:
+                return json.load(f)
+        finally:
+            os.unlink(out_path)
+
+    # -------------- watcher loop --------------
+
+    def poll_once(self) -> int:
+        """Discover + evaluate new checkpoints; returns #evaluated."""
+        fresh = discover_new_steps(self.save_dir, self.role, self._seen)
+        self.steps.extend(fresh)
+        n = 0
+        for step in self.steps:
+            if step.status != "pending":
+                continue
+            step.status = "running"
+            try:
+                step.scores = self._run_eval(step)
+                step.status = "done"
+                n += 1
+                logger.info(f"eval step {step.step}: {step.scores}")
+                if self.writer is not None:
+                    metrics = {
+                        f"eval/{k}": v
+                        for k, v in (step.scores or {}).items()
+                        if isinstance(v, (int, float))
+                    }
+                    self.writer.log(metrics, step=step.step)
+            except Exception as e:  # noqa: BLE001 — eval must not kill training
+                step.status = "failed"
+                logger.error(f"eval step {step.step} failed: {e}")
+            if n >= self.cfg.max_concurrent_jobs:
+                break
+        return n
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.poll_secs)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run_forever, daemon=True, name="auto-eval"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)

@@ -298,10 +298,32 @@ class LocalLauncher:
             # control plane, the rest replay its broadcasts.
             import copy as _copy
 
+            # On real TPU, partition the trainer chip list across the dist
+            # processes — copying the same list would have every process
+            # initialize the same chips (the double-claim the chip
+            # assignment exists to prevent).
+            chip_slices = [None] * n_dist
+            # With trainer_dist_devices_per_proc set, trainer_entry forces
+            # virtual CPU devices per process and the chip list is unused.
+            if (chips["trainer"] is not None
+                    and not getattr(exp, "trainer_dist_devices_per_proc",
+                                    None)):
+                tchips = list(chips["trainer"])
+                if len(tchips) % n_dist != 0:
+                    raise RuntimeError(
+                        f"trainer_dist_procs={n_dist} does not divide the "
+                        f"{len(tchips)} trainer chips {tchips}; pick a "
+                        "divisor"
+                    )
+                per = len(tchips) // n_dist
+                chip_slices = [
+                    tchips[r * per:(r + 1) * per] for r in range(n_dist)
+                ]
             for r in range(n_dist):
                 tc = _copy.deepcopy(setup["trainer"])
                 tc.dist_rank = r
                 tc.dist_world = n_dist
+                tc.chips = chip_slices[r]
                 tc.dist_local_devices = getattr(
                     exp, "trainer_dist_devices_per_proc", None
                 )
@@ -320,10 +342,31 @@ class LocalLauncher:
                 self._spawn(rollout_entry, exp, rc, self.force_cpu,
                             name=f"rollout{i}")
 
+        evaluator = None
+        if getattr(exp, "auto_eval", False):
+            from areal_tpu.apps.evaluator import AutomaticEvaluator
+
+            eval_data = exp.auto_eval_config.data_names
+            if not os.path.isfile(eval_data):
+                # The reference names vendored benchmark sets; here any
+                # prompt jsonl works — default to the training set's path.
+                eval_data = exp.dataset.path
+            evaluator = AutomaticEvaluator(
+                exp.auto_eval_config,
+                save_dir=setup["master"].save_dir,
+                dataset_path=eval_data,
+                mock_tokenizer=bool(getattr(exp, "mock_tokenizer", False)),
+            )
+            evaluator.start()
+            logger.info(f"automatic evaluator watching "
+                        f"{setup['master'].save_dir} (data: {eval_data})")
+
         master = MasterWorker(setup["master"], setup["dfg"])
         try:
             result = self._run_master_monitored(master)
         finally:
+            if evaluator is not None:
+                evaluator.stop()
             self.shutdown()
         return result
 
@@ -369,10 +412,14 @@ def run_experiment(exp_cfg) -> Dict[str, Any]:
     launcher-level restart loop (``realhf/apps/main.py:118-180``).
     """
     mode = getattr(exp_cfg, "mode", "local")
+    if mode == "slurm":
+        from areal_tpu.apps.slurm import SlurmLauncher
+
+        return SlurmLauncher(exp_cfg).run()
     if mode != "local":
         raise NotImplementedError(
-            f"mode={mode!r}: only 'local' (single-host) is implemented; "
-            "multi-host launch lands with the jax.distributed runtime"
+            f"mode={mode!r}: 'local' (single-host) and 'slurm' (cluster) "
+            "are implemented"
         )
     recover_mode = getattr(exp_cfg, "recover_mode", "disabled")
     retries = (

@@ -1,0 +1,242 @@
+"""Slurm scheduler client + launcher.
+
+Parity target: ``realhf/scheduler/client.py:53`` (SchedulerClient ABC),
+``realhf/scheduler/slurm/client.py:78`` (SlurmSchedulerClient — sbatch
+script generation, submit, poll, cancel) and ``realhf/apps/main.py:80``
+(one scheduler job per worker group).
+
+TPU shape: one sbatch job per worker group. The trainer job runs N tasks
+(one SPMD process per host; they rendezvous through name_resolve →
+``jax.distributed``, parallel/distributed.py); the generation fleet,
+rollout workers and master are single- or multi-task CPU/TPU jobs. Every
+task execs ``python -m areal_tpu.apps.remote`` with the dumped config.yaml,
+so worker code is identical to local mode.
+
+The subprocess runner is injectable for tests (no slurm on dev machines).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("apps.slurm")
+
+Runner = Callable[..., "subprocess.CompletedProcess"]
+
+# squeue job-state codes that mean "still going" (reference
+# scheduler/slurm/utils.py status mapping).
+ACTIVE_STATES = {"PENDING", "RUNNING", "CONFIGURING", "COMPLETING",
+                 "SUSPENDED", "REQUEUED"}
+FAILED_STATES = {"FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL",
+                 "OUT_OF_MEMORY", "PREEMPTED", "BOOT_FAIL", "DEADLINE"}
+
+
+@dataclasses.dataclass
+class SlurmJobSpec:
+    """One worker group = one sbatch job."""
+
+    name: str
+    cmd: str  # the per-task command line (srun runs it ntasks times)
+    ntasks: int = 1
+    nodes: Optional[int] = None  # default: let slurm pack
+    cpus_per_task: int = 2
+    mem_per_task_mb: int = 8192
+    tpus_per_task: int = 0  # rendered as a gres request when > 0
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    time_limit: Optional[str] = None
+    partition: Optional[str] = None
+    container: Optional[str] = None  # pyxis image, if the cluster uses one
+    exclusive: bool = False
+
+
+def render_sbatch_script(spec: SlurmJobSpec, log_dir: str) -> str:
+    """The sbatch file for one worker group (reference
+    slurm/utils.py:144 SlurmLaunchInfo.commit)."""
+    lines = ["#!/bin/bash"]
+    lines.append(f"#SBATCH --job-name={spec.name}")
+    lines.append(f"#SBATCH --ntasks={spec.ntasks}")
+    if spec.nodes:
+        lines.append(f"#SBATCH --nodes={spec.nodes}")
+    lines.append(f"#SBATCH --cpus-per-task={spec.cpus_per_task}")
+    lines.append(f"#SBATCH --mem-per-cpu="
+                 f"{max(1, spec.mem_per_task_mb // spec.cpus_per_task)}M")
+    if spec.tpus_per_task:
+        lines.append(f"#SBATCH --gres=tpu:{spec.tpus_per_task}")
+    if spec.partition:
+        lines.append(f"#SBATCH --partition={spec.partition}")
+    if spec.time_limit:
+        lines.append(f"#SBATCH --time={spec.time_limit}")
+    if spec.exclusive:
+        lines.append("#SBATCH --exclusive")
+    lines.append(f"#SBATCH --output={log_dir}/{spec.name}.%j.out")
+    lines.append(f"#SBATCH --error={log_dir}/{spec.name}.%j.err")
+    lines.append("")
+    for k, v in sorted(spec.env.items()):
+        lines.append(f"export {k}={v!r}")
+    srun = "srun"
+    if spec.container:
+        srun += f" --container-image={spec.container}"
+    lines.append(f"{srun} {spec.cmd}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+class SlurmClient:
+    """submit / poll / cancel sbatch jobs (runner injectable for tests)."""
+
+    def __init__(self, log_dir: str, runner: Optional[Runner] = None):
+        self.log_dir = log_dir
+        self.runner = runner or subprocess.run
+        self.jobs: Dict[str, str] = {}  # name -> job id
+
+    def _run(self, cmd: List[str]) -> "subprocess.CompletedProcess":
+        r = self.runner(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed rc={r.returncode}: {r.stderr}"
+            )
+        return r
+
+    def submit(self, spec: SlurmJobSpec) -> str:
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, f"{spec.name}.sbatch")
+        with open(path, "w") as f:
+            f.write(render_sbatch_script(spec, self.log_dir))
+        r = self._run(["sbatch", "--parsable", path])
+        job_id = r.stdout.strip().split(";")[0]
+        self.jobs[spec.name] = job_id
+        logger.info(f"submitted {spec.name} as slurm job {job_id}")
+        return job_id
+
+    def states(self) -> Dict[str, str]:
+        """name -> slurm state; jobs that left the queue are COMPLETED
+        unless sacct reports otherwise."""
+        if not self.jobs:
+            return {}
+        ids = ",".join(self.jobs.values())
+        r = self._run(["squeue", "-j", ids, "-h", "-o", "%i %T"])
+        by_id = {}
+        for line in r.stdout.strip().splitlines():
+            parts = line.split()
+            if len(parts) >= 2:
+                by_id[parts[0]] = parts[1]
+        out = {}
+        for name, jid in self.jobs.items():
+            out[name] = by_id.get(jid, "COMPLETED")
+        return out
+
+    def wait(
+        self,
+        poll_secs: float = 10.0,
+        until_done: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict[str, str]:
+        """Block until a job fails, everything finishes, or (if
+        ``until_done`` names a job) that job completes — the launcher waits
+        on the master and then tears the rest down."""
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            st = self.states()
+            failed = {n: s for n, s in st.items() if s in FAILED_STATES}
+            if failed:
+                raise RuntimeError(f"slurm jobs failed: {failed}")
+            if until_done and st.get(until_done) == "COMPLETED":
+                return st
+            if all(s == "COMPLETED" for s in st.values()):
+                return st
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(f"slurm wait timed out; states={st}")
+            time.sleep(poll_secs)
+
+    def cancel_all(self) -> None:
+        for name, jid in self.jobs.items():
+            try:
+                self._run(["scancel", jid])
+            except RuntimeError as e:  # noqa: PERF203 — best-effort teardown
+                logger.warning(f"scancel {name} ({jid}): {e}")
+
+
+def build_job_specs(exp_cfg, config_path: str) -> List[SlurmJobSpec]:
+    """Map an experiment's worker groups onto sbatch jobs."""
+    from areal_tpu.experiments import registered_name_of
+    from areal_tpu.parallel.mesh import AllocationMode
+
+    exp = exp_cfg.experiment_name
+    cls = registered_name_of(exp_cfg)
+    base = (f"python -m areal_tpu.apps.remote --experiment-cls {cls} "
+            f"--config {config_path}")
+    am = AllocationMode.parse(getattr(exp_cfg, "allocation_mode", "") or "d1")
+    chips_per_host = max(1, getattr(exp_cfg, "n_gpus_per_node", 4))
+    train_chips = am.global_spec.world_size
+    train_hosts = max(1, -(-train_chips // chips_per_host))
+    specs = [
+        SlurmJobSpec(
+            name=f"{exp}-master",
+            cmd=f"{base} --role master",
+            ntasks=1,
+        ),
+        SlurmJobSpec(
+            name=f"{exp}-trainer",
+            cmd=f"{base} --role trainer",
+            ntasks=train_hosts,
+            nodes=train_hosts,
+            tpus_per_task=min(train_chips, chips_per_host),
+            cpus_per_task=8,
+            mem_per_task_mb=64 * 1024,
+            exclusive=train_hosts > 1,
+        ),
+    ]
+    if am.decoupled:
+        gen_chips = am.gen_spec.world_size
+        gen_hosts = max(1, -(-gen_chips // chips_per_host))
+        specs.append(SlurmJobSpec(
+            name=f"{exp}-gen",
+            cmd=f"{base} --role gen_fleet",
+            ntasks=gen_hosts,
+            nodes=gen_hosts,
+            tpus_per_task=min(gen_chips, chips_per_host),
+            cpus_per_task=8,
+            mem_per_task_mb=64 * 1024,
+        ))
+        n_rollout = max(1, getattr(exp_cfg, "n_rollout_workers", 1))
+        specs.append(SlurmJobSpec(
+            name=f"{exp}-rollout",
+            cmd=f"{base} --role rollout --index $SLURM_PROCID",
+            ntasks=n_rollout,
+        ))
+    return specs
+
+
+class SlurmLauncher:
+    """mode="slurm": dump config.yaml, submit one job per worker group,
+    wait on the master, tear down (reference apps/main.py:80)."""
+
+    def __init__(self, exp_cfg, runner: Optional[Runner] = None):
+        self.exp_cfg = exp_cfg
+        self.runner = runner
+
+    def run(self) -> Dict[str, Any]:
+        from areal_tpu.api import cli_args as CA
+        from areal_tpu.experiments import common as C
+
+        exp = self.exp_cfg
+        exp.resolve_trial_name()
+        C.setup_name_resolve(exp)
+        log_dir = CA.get_log_path(exp)
+        config_path = os.path.join(log_dir, "config.yaml")
+        CA.save_yaml(exp, config_path)
+        client = SlurmClient(log_dir, runner=self.runner)
+        master_job = f"{exp.experiment_name}-master"
+        try:
+            for spec in build_job_specs(exp, config_path):
+                client.submit(spec)
+            client.wait(until_done=master_job)
+            return {"steps": None, "slurm_jobs": dict(client.jobs)}
+        finally:
+            client.cancel_all()

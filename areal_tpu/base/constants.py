@@ -1,22 +1,23 @@
-"""Per-process global context.
+"""Per-process global context + canonical filesystem layout.
 
-Parity target: ``realhf/base/constants.py:215`` — experiment/trial names,
-per-model scoped context (the reference swaps Megatron process groups per
-model role with ``model_scope``; here the scoped object is the model role's
-``jax.sharding.Mesh`` and axis names), and canonical filesystem layout.
+Parity target: ``realhf/base/constants.py:215``. Two of the reference's three
+concerns port: experiment/trial identity (set once per process, used by
+logging and the path helpers) and the directory schema every component
+shares (``experiments/common.experiment_paths`` delegates here). The third —
+``model_scope`` swapping Megatron process groups per model role — has no
+TPU equivalent by design: under GSPMD a model role's parallelism is carried
+by its ``jax.sharding.Mesh`` object (parallel/mesh.py), passed explicitly,
+not by mutable process-global state.
 """
 
 from __future__ import annotations
 
 import getpass
 import os
-from contextlib import contextmanager
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 _experiment_name: Optional[str] = None
 _trial_name: Optional[str] = None
-_model_scope: list = []
-_model_ctx: Dict[str, Any] = {}
 
 
 def set_experiment_trial_names(experiment: str, trial: str) -> None:
@@ -37,56 +38,55 @@ def trial_name() -> str:
     return _trial_name
 
 
-def has_model_scope() -> bool:
-    return bool(_model_scope)
-
-
-def current_model_name() -> str:
-    if not _model_scope:
-        raise RuntimeError("not inside model_scope")
-    return _model_scope[-1]
-
-
-@contextmanager
-def model_scope(name: str):
-    _model_scope.append(name)
-    try:
-        yield
-    finally:
-        _model_scope.pop()
-
-
-def set_model_context(name: str, **ctx) -> None:
-    _model_ctx.setdefault(name, {}).update(ctx)
-
-
-def model_context(name: Optional[str] = None) -> Dict[str, Any]:
-    return _model_ctx.get(name or current_model_name(), {})
-
-
 # ---- filesystem layout ----
+#
+# One experiment trial owns one directory tree under a cluster fileroot:
+#   <fileroot>/<experiment>/<trial>/{checkpoints,realloc,recover,
+#                                    name_resolve,logs}
+# ``realloc`` is where the trainer publishes weights for the generation
+# fleet (the disk weight-sync path; reference model_worker.py:1053
+# REAL_PARAM_REALLOC_IMPL=DISK).
 
-def get_cache_root() -> str:
+
+def get_fileroot() -> str:
     return os.environ.get(
         "AREAL_CACHE_ROOT", os.path.join("/tmp", getpass.getuser(), "areal_tpu")
     )
 
 
-def get_log_root(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    return os.path.join(
-        get_cache_root(), "logs", experiment or experiment_name(), trial or trial_name()
+def experiment_paths(
+    experiment: Optional[str] = None,
+    trial: Optional[str] = None,
+    fileroot: Optional[str] = None,
+) -> Dict[str, str]:
+    root = os.path.join(
+        fileroot or get_fileroot(),
+        experiment or experiment_name(),
+        trial or trial_name(),
     )
+    return {
+        "root": root,
+        "save": os.path.join(root, "checkpoints"),
+        "realloc": os.path.join(root, "realloc"),
+        "recover": os.path.join(root, "recover"),
+        "name_resolve": os.path.join(root, "name_resolve"),
+        "log": os.path.join(root, "logs"),
+    }
 
 
-def get_save_root(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    return os.path.join(
-        get_cache_root(), "checkpoints", experiment or experiment_name(), trial or trial_name()
-    )
+def get_save_root(
+    experiment: Optional[str] = None, trial: Optional[str] = None
+) -> str:
+    return experiment_paths(experiment, trial)["save"]
 
 
-def get_param_realloc_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    """Where the trainer publishes weights for the generation fleet (the disk
-    weight-sync path; reference: model_worker.py:1053 DISK realloc impl)."""
-    return os.path.join(
-        get_cache_root(), "param_realloc", experiment or experiment_name(), trial or trial_name()
-    )
+def get_param_realloc_path(
+    experiment: Optional[str] = None, trial: Optional[str] = None
+) -> str:
+    return experiment_paths(experiment, trial)["realloc"]
+
+
+def get_log_root(
+    experiment: Optional[str] = None, trial: Optional[str] = None
+) -> str:
+    return experiment_paths(experiment, trial)["log"]

@@ -39,7 +39,17 @@ def _encode(tokenizer, text: str) -> List[int]:
 
 def load_jsonl(path: str) -> List[Dict[str, Any]]:
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        records = [json.loads(line) for line in f if line.strip()]
+    # "@" is reserved as the structural separator in sample ids
+    # ("<query_id>@<group_idx>", "<query_id>@r<epoch>"); a raw query_id
+    # containing it would make reward lookups silently miss. Fail loudly.
+    for r in records:
+        if "@" in str(r.get("query_id", "")):
+            raise ValueError(
+                f"query_id {r['query_id']!r} in {path} contains '@', which "
+                "is reserved for sample-id suffixes; rename the record"
+            )
+    return records
 
 
 def load_shuffle_split(

@@ -26,3 +26,22 @@ def make_experiment_cls(name: str) -> Type:
             f"unknown experiment '{name}'; have {sorted(_REGISTRY)}"
         )
     return _REGISTRY[name]
+
+
+def registered_name_of(cfg) -> str:
+    """Reverse registry lookup for a config instance — the most-derived
+    registered class wins (AsyncPPOMATHConfig subclasses PPOMATHConfig)."""
+    import areal_tpu.experiments.async_ppo_math_exp  # noqa: F401
+    import areal_tpu.experiments.ppo_math_exp  # noqa: F401
+    import areal_tpu.experiments.sft_exp  # noqa: F401
+
+    best = None
+    for name, cls in _REGISTRY.items():
+        if isinstance(cfg, cls) and (
+            best is None or issubclass(cls, _REGISTRY[best])
+        ):
+            best = name
+    if best is None:
+        raise ValueError(f"{type(cfg).__name__} is not a registered "
+                         "experiment config")
+    return best

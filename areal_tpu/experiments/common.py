@@ -10,13 +10,13 @@ fleet owns a disjoint slice when the allocation mode is decoupled.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, Optional
 
 from areal_tpu.api.cli_args import (
     BaseExperimentConfig,
     ModelTrainEvalConfig,
 )
+from areal_tpu.base import constants
 from areal_tpu.parallel.mesh import AllocationMode, ParallelSpec
 
 
@@ -72,20 +72,12 @@ def make_tokenizer(cfg: BaseExperimentConfig, model_path: str):
 
 
 def experiment_paths(cfg: BaseExperimentConfig) -> Dict[str, str]:
-    root = os.path.join(
-        cfg.cluster.fileroot, cfg.experiment_name, cfg.trial_name
+    paths = constants.experiment_paths(
+        cfg.experiment_name, cfg.trial_name, fileroot=cfg.cluster.fileroot
     )
-    return {
-        "root": root,
-        "save": os.path.join(root, "checkpoints"),
-        "realloc": os.path.join(root, "realloc"),
-        "recover": os.path.join(root, "recover"),
-        "name_resolve": (
-            cfg.cluster.name_resolve.nfs_record_root
-            or os.path.join(root, "name_resolve")
-        ),
-        "log": os.path.join(root, "logs"),
-    }
+    if cfg.cluster.name_resolve.nfs_record_root:
+        paths["name_resolve"] = cfg.cluster.name_resolve.nfs_record_root
+    return paths
 
 
 def setup_name_resolve(cfg: BaseExperimentConfig) -> None:
@@ -98,6 +90,7 @@ def setup_name_resolve(cfg: BaseExperimentConfig) -> None:
 
     from areal_tpu.base import name_resolve
 
+    constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
     nr = cfg.cluster.name_resolve
     if nr.type == "nfs" and not nr.nfs_record_root:
         nr = dc.replace(nr, nfs_record_root=experiment_paths(cfg)["name_resolve"])

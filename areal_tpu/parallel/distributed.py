@@ -132,6 +132,12 @@ def allgather_params(params: Any) -> Any:
     from jax.sharding import PartitionSpec as P
 
     if jax.process_count() == 1:
+        # Queue every d2h copy before the first blocking read so later
+        # transfers overlap earlier ones (and any host-side serialization
+        # the caller does per tensor).
+        for leaf in jax.tree_util.tree_leaves(params):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
         return jax.device_get(params)
     leaves = jax.tree_util.tree_leaves(params)
     mesh = leaves[0].sharding.mesh

@@ -89,6 +89,10 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
         "layers": layers,
         "final_ln": P(None),
     }
+    if cfg.norm_type == "layer":
+        specs["final_ln_b"] = P(None)
+    if cfg.pos_embedding == "learned":
+        specs["pos_embedding"] = P(None, "fsdp")
     if cfg.is_critic:
         specs["value_head"] = P("fsdp", None)
     elif not cfg.tie_word_embeddings:

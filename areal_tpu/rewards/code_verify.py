@@ -13,7 +13,10 @@ Two test-case styles (same as the reference / LiveCodeBench):
 from __future__ import annotations
 
 import json
+import os
 import re
+import resource
+import signal
 import subprocess
 import sys
 import tempfile
@@ -25,6 +28,71 @@ logger = logging.getLogger("rewards.code")
 
 _CODE_BLOCK = re.compile(r"```(?:python|py)?\n(.*?)```", re.DOTALL)
 MAX_OUTPUT_BYTES = 4 * 1024 * 1024  # cap read-back of graded program output
+
+# Sandbox limits for the graded program (reference
+# functioncall/code/function/testing_util.py:702-760 reliability_guard:
+# rlimits + os/builtins disarm before running untrusted model code).
+MEM_LIMIT_BYTES = 1024 * 1024 * 1024  # RLIMIT_AS
+FSIZE_LIMIT_BYTES = 64 * 1024 * 1024  # RLIMIT_FSIZE
+
+# Injected ABOVE the untrusted code: disarm os-level footguns and
+# escape hatches inside the child (belt; the rlimits below are braces).
+_GUARD = """\
+import builtins as _b
+import os as _os
+import sys as _s
+_s.setrecursionlimit(100000)
+for _name in (
+    "system", "popen", "execv", "execve", "execvp", "execvpe", "fork",
+    "forkpty", "spawnl", "spawnv", "spawnve", "killpg", "kill", "rename",
+    "renames", "truncate", "replace", "unlink", "removedirs", "rmdir",
+    "remove", "chmod", "chown", "chroot", "lchown", "setuid", "setgid",
+    "fchmod", "fchown", "putenv",
+):
+    if hasattr(_os, _name):
+        setattr(_os, _name, None)
+_b.exit = None
+_b.quit = None
+try:
+    import shutil as _sh
+    _sh.rmtree = None
+    _sh.move = None
+    _sh.chown = None
+except Exception:
+    pass
+try:
+    import subprocess as _sp
+    _sp.Popen = None
+    _sp.run = None
+    _sp.call = None
+    _sp.check_output = None
+except Exception:
+    pass
+del _b, _os, _s, _name
+"""
+
+
+def _child_limits(cpu_seconds: int):
+    """preexec_fn for the grading subprocess: hard rlimits. Runs between
+    fork and exec, so it must not import or allocate — ``resource`` is
+    captured from the module scope (imported at module load) and the
+    session split is done by ``start_new_session=True``, not os.setsid
+    here (fork-safety in a multithreaded parent)."""
+
+    def fn():
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu_seconds, cpu_seconds + 1))
+        resource.setrlimit(
+            resource.RLIMIT_FSIZE, (FSIZE_LIMIT_BYTES, FSIZE_LIMIT_BYTES)
+        )
+        resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+        try:
+            resource.setrlimit(
+                resource.RLIMIT_AS, (MEM_LIMIT_BYTES, MEM_LIMIT_BYTES)
+            )
+        except ValueError:
+            pass
+
+    return fn
 
 
 def extract_code(text: str) -> Optional[str]:
@@ -55,6 +123,7 @@ def _run_one(
         src = _FN_RUNNER.format(code=code, fn_name=fn_name)
     else:
         src = code
+    src = _GUARD + src
     with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
         f.write(src)
         path = f.name
@@ -62,29 +131,45 @@ def _run_one(
     # the trainer host's RSS; read back capped.
     out_f = tempfile.NamedTemporaryFile("w+", delete=False)
     err_f = tempfile.NamedTemporaryFile("w+", delete=False)
+    scratch = tempfile.mkdtemp(prefix="areal_sbx_")
+    proc = None
     try:
-        proc = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, path],
-            input=stdin,
+            stdin=subprocess.PIPE,
             stdout=out_f,
             stderr=err_f,
             text=True,
-            timeout=timeout,
+            cwd=scratch,
+            env={"PATH": os.environ.get("PATH", ""), "HOME": scratch,
+                 "OMP_NUM_THREADS": "1"},
+            start_new_session=True,
+            preexec_fn=_child_limits(int(timeout) + 1),
         )
+        try:
+            proc.communicate(stdin, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            # Kill the whole session: with os.setsid in the child, forked
+            # grandchildren would otherwise outlive the timeout.
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+            return False, "timeout"
         err_f.seek(0)
         if proc.returncode != 0:
             return False, err_f.read(500)
         out_f.seek(0)
         return True, out_f.read(MAX_OUTPUT_BYTES)
-    except subprocess.TimeoutExpired:
-        return False, "timeout"
     finally:
-        import os
+        import shutil
 
         for fh in (out_f, err_f):
             fh.close()
             os.unlink(fh.name)
         os.unlink(path)
+        shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _outputs_match(got: str, want: str) -> bool:

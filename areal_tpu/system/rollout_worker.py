@@ -69,16 +69,16 @@ class RolloutWorker:
         self._done = 0
         self._pushed = 0
 
-    def _prompt_sample(self, rec) -> SequenceSample:
+    def _prompt_sample(self, rec, uid: str) -> SequenceSample:
         ids = self.cfg.tokenizer.encode(rec["prompt"])
         return SequenceSample.from_default(
-            ids=[str(rec["query_id"])],
+            ids=[uid],
             data={"packed_prompts": np.asarray(ids, np.int32)},
             seqlens=[len(ids)],
             metadata={"task": [rec.get("task", "math")]},
         )
 
-    async def _rollout_one(self, rec, client, pusher, mgr_url, session):
+    async def _rollout_one(self, rec, uid, client, pusher, mgr_url, session):
         cfg = self.cfg
         # quota / staleness gate — allocate in SAMPLE units: one prompt
         # produces group_size samples, and the manager's is_staled /
@@ -94,7 +94,7 @@ class RolloutWorker:
             return False
         accepted = 0
         try:
-            prompt = self._prompt_sample(rec)
+            prompt = self._prompt_sample(rec, uid)
             obs_q: asyncio.Queue = asyncio.Queue()
             act_q: asyncio.Queue = asyncio.Queue()
             task = asyncio.create_task(
@@ -150,17 +150,27 @@ class RolloutWorker:
             sem = asyncio.Semaphore(cfg.max_concurrent)
             pos = 0
 
-            async def one(rec):
+            async def one(rec, uid):
                 async with sem:
-                    await self._rollout_one(rec, client, pusher, mgr_url,
-                                            session)
+                    # A denied allocation (staleness/capacity gate) must not
+                    # drop the prompt — retry until the gate opens.
+                    while not await self._rollout_one(
+                        rec, uid, client, pusher, mgr_url, session
+                    ):
+                        pass
 
             pending = set()
             while cfg.max_rollouts is None or self._done < cfg.max_rollouts:
                 while len(pending) < cfg.max_concurrent:
                     rec = self.records[pos % len(self.records)]
+                    # Epoch passes over a small dataset re-visit the same
+                    # query_id; tag the pass so trajectory ids stay globally
+                    # unique (the buffer rejects duplicate sample ids).
+                    epoch = pos // len(self.records)
+                    qid = str(rec["query_id"])
+                    uid = qid if epoch == 0 else f"{qid}@r{epoch}"
                     pos += 1
-                    pending.add(asyncio.create_task(one(rec)))
+                    pending.add(asyncio.create_task(one(rec, uid)))
                 done, pending = await asyncio.wait(
                     pending, return_when=asyncio.FIRST_COMPLETED
                 )

@@ -520,9 +520,22 @@ class TrainerWorker:
                     post_hooks=post)
         self._dispatch(p)
         if p.exception:
-            raise RuntimeError(
-                f"rank {self.cfg.dist_rank} replay of {handle_name} failed: "
-                f"{p.exception}"
+            # Deterministic errors fail identically on every rank; mirroring
+            # rank 0 (catch, log, keep serving) keeps the group in lockstep.
+            # But a rank-LOCAL failure of a state-mutating handler (mfc
+            # optimizer step, restore, clear) means this rank's params/state
+            # now diverge from the group — continuing would train silently
+            # corrupted. Fail loudly instead; the launcher's child monitor
+            # tears the run down.
+            if handle_name in ("mfc", "restore", "clear"):
+                raise RuntimeError(
+                    f"rank {self.cfg.dist_rank} replay of state-mutating "
+                    f"{handle_name} failed — exiting to avoid silent SPMD "
+                    f"divergence: {p.exception}"
+                )
+            logger.error(
+                f"rank {self.cfg.dist_rank} replay of {handle_name} failed "
+                f"(read-only; continuing to stay in sync): {p.exception}"
             )
 
     def run(self) -> None:

@@ -96,20 +96,31 @@ def main():
     tokens_per_sec_chip = steps * total / dt / n_chips
 
     # North-star metric #2 (BASELINE.json): trainer→rollout weight-sync
-    # latency. Measured as the full disk path on this chip: sharded
+    # latency. Measured as the full disk path on this chip: sharded bf16
     # safetensors save → threaded load → device_put swap (what
     # trainer_worker.publish_weights + generation_server /update_weights do).
+    # The breakdown separates what the framework controls (serialize + disk
+    # IO) from raw host<->device transport: on this harness the chip is
+    # remote (axon tunnel, measured ~9 MB/s serialized — 1 GB of bf16 params
+    # takes ~110 s EACH way regardless of software), while on a real v5p
+    # host the same legs ride PCIe at ~10 GB/s (~0.2 s round trip), leaving
+    # the IO number as the true system latency.
     import shutil
     import tempfile
 
     from areal_tpu.models import hf as hfmod
+    from areal_tpu.parallel import distributed as dist
 
     eng = model.module
     sync_dir = tempfile.mkdtemp(prefix="areal_sync_")
     try:
         t0 = time.perf_counter()
-        hfmod.save_hf_checkpoint(jax.device_get(eng.params), cfg, sync_dir)
+        host_params = dist.allgather_params(eng.params)  # d2h (overlapped)
+        t_get = time.perf_counter()
+        hfmod.save_hf_checkpoint(host_params, cfg, sync_dir)
+        t_save = time.perf_counter()
         _, loaded = hfmod.load_hf_checkpoint(sync_dir)
+        t_load = time.perf_counter()
         new_params = jax.tree.map(
             lambda old, npv: jax.device_put(
                 np.asarray(npv, dtype=old.dtype), old.sharding
@@ -117,7 +128,10 @@ def main():
             eng.params, loaded,
         )
         jax.block_until_ready(new_params)
-        weight_sync_s = time.perf_counter() - t0
+        t_put = time.perf_counter()
+        weight_sync_s = t_put - t0
+        weight_sync_transport_s = (t_get - t0) + (t_put - t_load)
+        weight_sync_io_s = (t_save - t_get) + (t_load - t_save)
     finally:
         shutil.rmtree(sync_dir, ignore_errors=True)
 
@@ -139,6 +153,8 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
         "weight_sync_latency_s": round(weight_sync_s, 3),
+        "weight_sync_io_s": round(weight_sync_io_s, 3),
+        "weight_sync_transport_s": round(weight_sync_transport_s, 3),
     }))
 
 

@@ -128,3 +128,46 @@ def test_batch_reward_local_dispatch():
         {"task": "math", "generated": "\\boxed{5}", "solutions": ["\\boxed{4}"]},
     ]
     assert batch_reward(tasks) == [1.0, 0.0]
+
+
+class TestSandboxHardening:
+    """reference testing_util.py:702-760 reliability_guard parity: untrusted
+    code is boxed by rlimits + an os/builtins disarm preamble."""
+
+    IO = '{"inputs": ["1\\n"], "outputs": ["1\\n"]}'
+
+    def test_normal_solution_still_passes(self):
+        gen = "```python\nprint(input())\n```"
+        assert code_verify.verify_code(gen, self.IO) == 1.0
+
+    def test_memory_hog_killed(self):
+        gen = "```python\nx = bytearray(8 * 1024**3)\nprint(input())\n```"
+        assert code_verify.verify_code(gen, self.IO, timeout=20.0) == 0.0
+
+    def test_os_system_disarmed(self):
+        gen = (
+            "```python\nimport os\nos.system('echo pwned')\n"
+            "print(input())\n```"
+        )
+        assert code_verify.verify_code(gen, self.IO) == 0.0
+
+    def test_subprocess_disarmed(self):
+        gen = (
+            "```python\nimport subprocess\n"
+            "subprocess.run(['echo', 'hi'])\nprint(input())\n```"
+        )
+        assert code_verify.verify_code(gen, self.IO) == 0.0
+
+    def test_cpu_spin_killed(self):
+        gen = "```python\nwhile True: pass\n```"
+        assert code_verify.verify_code(gen, self.IO, timeout=3.0) == 0.0
+
+    def test_file_write_confined_to_scratch(self, tmp_path):
+        marker = tmp_path / "escape.txt"
+        gen = (
+            "```python\n"
+            "open('escape.txt', 'w').write('x')\n"  # lands in scratch cwd
+            "print(input())\n```"
+        )
+        assert code_verify.verify_code(gen, self.IO) == 1.0
+        assert not marker.exists()

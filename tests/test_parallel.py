@@ -94,3 +94,25 @@ def test_sharded_grad_runs():
 
     g = jax.jit(jax.grad(loss))(sp)
     assert jnp.isfinite(jax.tree.reduce(lambda a, b: a + jnp.sum(b), g, 0.0))
+
+
+def test_shard_params_gpt2_family_on_mesh():
+    """Advisor r3 (medium): param_partition_specs must cover the
+    final_ln_b (norm_type='layer') and pos_embedding (learned) keys the
+    GPT-2 codec creates, or shard_params tree-maps mismatched trees."""
+    import jax
+
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.parallel import sharding
+
+    cfg = tiny_config(
+        norm_type="layer", pos_embedding="learned", mlp_type="plain",
+        use_attention_bias=True, use_attn_output_bias=True,
+        max_position_embeddings=64,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    m = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
+    sharded = sharding.shard_params(params, m, cfg)
+    assert sharded["final_ln_b"].shape == params["final_ln_b"].shape
+    assert sharded["pos_embedding"].sharding.mesh.shape == m.shape

@@ -138,3 +138,17 @@ def test_train_step_uniform_matches_legacy_params():
         )
     assert s1["mean_kl"] == pytest.approx(s2["mean_kl"], abs=1e-6)
     assert s1["n_action_tokens"] == s2["n_action_tokens"]
+
+
+def test_fast_path_takes_n_minibatch_steps():
+    """Advisor r3 (high): with the default MicroBatchSpec the packer puts
+    the whole batch in one uniform micro-batch, which silently collapsed
+    ppo_n_minibatches optimizer steps into one. The fast path must request
+    at least ppo_n_minibatches micro-batches from the packer."""
+    hp = PPOHyperparameters(ppo_n_minibatches=4, adv_norm=True, kl_ctl=0.0,
+                            disable_value=True)
+    batch = _make_batch(n_seq=16)
+    model = _engine()
+    iface = PPOActorInterface(hp)
+    stats = iface.train_step(model, batch, MicroBatchSpec())
+    assert stats["n_ppo_steps"] == 4.0
