@@ -1,0 +1,9 @@
+# Importing the package registers all built-in interfaces (the reference
+# does this in realhf/impl/__init__.py with its register_* calls).
+from areal_tpu.algorithms import (  # noqa: F401
+    fused,
+    ppo,
+    reward,
+    rw,
+    sft,
+)

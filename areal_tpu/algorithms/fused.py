@@ -1,0 +1,83 @@
+"""Fused concurrent forward interface.
+
+Parity target: ``realhf/impl/model/interface/fused_interface.py:23``
+(FusedThreadingForwardInterface, registered "fused-threading"): one MFC that
+runs several child interfaces' ``inference`` concurrently in threads and
+merges their output samples. The headline use is fusing ref-logprob
+inference (TPU-bound) with rule-based reward verification (CPU/subprocess-
+bound) into one DFG node — the two overlap instead of serializing, and the
+master schedules one round-trip instead of two.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional, Tuple
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import (
+    Model,
+    ModelInterface,
+    make_interface,
+    register_interface,
+)
+from areal_tpu.base import logging
+
+logger = logging.getLogger("algorithms.fused")
+
+
+@dataclasses.dataclass
+class FusedForwardInterface(ModelInterface):
+    """``interfaces``: {child_name: (registered_interface_name, kwargs)}.
+
+    All children run ``inference`` on the SAME (model, data, mb_spec) in a
+    thread pool; their outputs merge via ``SequenceSample.update_`` (key
+    sets must be disjoint). Thread safety holds because jax dispatch is
+    thread-safe and the reward child only reads the tokenizer.
+    """
+
+    interfaces: Dict[str, Tuple[str, Dict[str, Any]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def __post_init__(self):
+        self._children: Dict[str, ModelInterface] = {
+            key: make_interface(name, **(kwargs or {}))
+            for key, (name, kwargs) in self.interfaces.items()
+        }
+        assert self._children, "fused interface needs at least one child"
+
+    def _run_one(self, key: str, model, data, mb_spec):
+        t0 = time.perf_counter()
+        out = self._children[key].inference(model, data, mb_spec)
+        logger.info(
+            f"fused child {key} took {time.perf_counter() - t0:.3f}s"
+        )
+        return out
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Optional[SequenceSample]:
+        with ThreadPoolExecutor(max_workers=len(self._children)) as pool:
+            futs = {
+                key: pool.submit(self._run_one, key, model, data, mb_spec)
+                for key in self._children
+            }
+            final: Optional[SequenceSample] = None
+            # Deterministic merge order (dict order), unlike as_completed —
+            # update_ asserts disjoint keys so order only affects id checks.
+            for key, fut in futs.items():
+                res = fut.result()
+                if res is None:
+                    continue
+                if final is None:
+                    final = res
+                else:
+                    final.update_(res)
+        return final
+
+
+register_interface("fused_forward", FusedForwardInterface)
+register_interface("fused-threading", FusedForwardInterface)

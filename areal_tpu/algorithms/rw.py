@@ -1,0 +1,141 @@
+"""Learned reward-model training — Bradley-Terry pairwise loss.
+
+Completes the ``RewardModelingPairedDataset`` path (reference
+``realhf/impl/dataset/rw_paired_dataset.py``; the reference ships the
+dataset for its legacy RLHF pipeline — the paired-RM *trainer* lives in
+earlier RealHF releases, and this interface is its TPU-native equivalent):
+a critic-headed model scores each answer at its final token, and pairs
+optimize ``-log σ(s_pos − s_neg)``.
+
+Data contract: the paired dataset emits one multi-segment sample per
+prompt (segments = pos,neg,pos,neg,...). ``train_step`` flattens each pair
+into two independent sequences tagged with per-sequence ``_pair_idx`` /
+``_pair_sign`` scalars; the packed grid keeps answers attention-isolated
+via segment ids, and the loss re-joins pairs on device with a segment-sum
+over ``_pair_idx``. Pairs that FFD packing separates across micro-batches
+are skipped for that step (counted in ``orphan_pairs``) — keep
+``max_tokens_per_mb`` large enough that this stays 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import Model, ModelInterface, register_interface
+
+
+def _pairwise_loss(values: jnp.ndarray, batch: Dict[str, jnp.ndarray]):
+    """values: [R, L] critic outputs. Per-seq score = value at the last
+    token; each pos sequence finds its neg partner by _pair_idx equality
+    (O(S²) over the tiny per-mb sequence count — no segment-id bounds to
+    manage); BT loss over pairs whose BOTH members landed in this
+    micro-batch."""
+    scores = values[batch["seq_rows"], batch["seq_last_cols"]]
+    mask = batch["seq_mask"]
+    sign = batch["_pair_sign"]
+    idx = batch["_pair_idx"]
+    same = (idx[:, None] == idx[None, :]).astype(jnp.float32)
+    neg_m = (sign < 0).astype(jnp.float32) * mask
+    pos_m = (sign > 0).astype(jnp.float32) * mask
+    partner_score = same @ (scores * neg_m)
+    partner_present = same @ neg_m
+    whole = pos_m * (partner_present == 1.0)
+    diff = scores - partner_score  # meaningful where whole == 1
+    # -log sigmoid(diff) = softplus(-diff)
+    loss = jnp.sum(jax.nn.softplus(-diff) * whole)
+    correct = jnp.sum((diff > 0).astype(jnp.float32) * whole)
+    n_pairs = jnp.sum(whole)
+    orphan = jnp.sum(pos_m) - n_pairs + jnp.sum(
+        neg_m * ((pos_m @ same) == 0.0)
+    )
+    return loss, {
+        "n_pairs": n_pairs, "correct_sum": correct, "loss_sum": loss,
+        "pos_score_sum": jnp.sum(scores * pos_m),
+        "neg_score_sum": jnp.sum(scores * neg_m),
+        "orphan_pairs": orphan,
+    }
+
+
+def _loss_weight(mb) -> float:
+    # Normalize by pairs, not tokens: every comparison counts equally
+    # regardless of answer length.
+    sign = mb.scalars["_pair_sign"]
+    return float((sign > 0).sum())
+
+
+def flatten_pairs(data: SequenceSample) -> SequenceSample:
+    """Paired multi-segment samples → one sample per ANSWER with
+    _pair_idx/_pair_sign scalars (global pair numbering)."""
+    out: List[SequenceSample] = []
+    pair = 0
+    for i in range(data.bs):
+        segs = data.seqlens["packed_input_ids"][i]
+        assert len(segs) % 2 == 0, "paired data needs pos/neg interleaved"
+        off = int(data.offsets("packed_input_ids")[i])
+        toks = data.data["packed_input_ids"]
+        for j in range(0, len(segs), 2):
+            for sign, name in ((1.0, "pos"), (-1.0, "neg")):
+                ln = int(segs[j + (sign < 0)])
+                out.append(SequenceSample.from_default(
+                    ids=[f"{data.ids[i]}@p{j // 2}{name}"],
+                    data={
+                        "packed_input_ids": toks[off : off + ln],
+                        "_pair_idx": np.asarray([pair], np.float32),
+                        "_pair_sign": np.asarray([sign], np.float32),
+                    },
+                    seqlens=[ln],
+                ))
+                off += ln
+            pair += 1
+    return SequenceSample.gather(out)
+
+
+@dataclasses.dataclass
+class RewardModelingInterface(ModelInterface):
+    n_minibatches: int = 1
+
+    def train_step(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict[str, float]:
+        engine = model.module
+        assert engine.cfg.is_critic, "reward model needs a scalar head"
+        flat = flatten_pairs(data)
+        stats = engine.train_batch(
+            flat, mb_spec, _pairwise_loss, _loss_weight,
+            token_normalize_scope="global",
+            version_steps=model.version.global_step,
+        )
+        model.inc_version()
+        n = max(stats.get("n_pairs", 1.0), 1.0)
+        stats["pairwise_accuracy"] = stats.pop("correct_sum", 0.0) / n
+        stats["pos_minus_neg"] = (
+            stats.pop("pos_score_sum", 0.0) - stats.pop("neg_score_sum", 0.0)
+        ) / n
+        return stats
+
+    def inference(
+        self, model: Model, data: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        """Per-sequence scores for already-flat (one answer per sample)
+        inputs — the serving path of a trained RM."""
+        engine = model.module
+
+        def hook(values, batch):
+            return values[..., None]  # [R, L, 1] per-token values
+
+        per_sample = engine.forward(data, mb_spec, post_hook=hook)
+        scores = np.asarray([float(p[-1, 0]) for p in per_sample], np.float32)
+        return SequenceSample.from_default(
+            ids=list(data.ids),
+            data={"scores": scores},
+            seqlens=[1] * data.bs,
+        )
+
+
+register_interface("rw_paired", RewardModelingInterface)

@@ -38,9 +38,11 @@ class ParamReallocHook(MFCHook):
     eta: float = 1.0  # target := eta * source + (1-eta) * target
 
 
-@dataclasses.dataclass
-class OffloadHook(MFCHook):
-    pass
+# NOTE: the reference also defines an OffloadHook (dfg.py:42) to evict
+# model weights to host RAM between MFCs under GPU memory pressure. There
+# is deliberately no TPU analogue: roles share chips through GSPMD
+# sharding + buffer donation, and XLA owns HBM residency — an explicit
+# offload hook would fight the compiler, not help it.
 
 
 @dataclasses.dataclass

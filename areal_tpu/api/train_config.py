@@ -30,6 +30,13 @@ class OptimizerConfig:
     warmup_steps_proportion: float = 0.02
     lr_scheduler_type: str = "constant"  # constant | cosine | linear
     gradient_clipping: float = 1.0
+    # Adam moment storage dtypes (master params are always f32). bf16
+    # moments halve optimizer HBM: mu is a smoothed gradient (fits bf16's
+    # range; the update math still runs in f32), nu in bf16 adds ~0.4%
+    # relative noise to the adaptive scale. Defaults keep nu exact; HBM-
+    # constrained configs (bench.py on a 16G chip) set nu_dtype=bfloat16.
+    mu_dtype: Optional[str] = "bfloat16"
+    nu_dtype: Optional[str] = "float32"
 
 
 @dataclasses.dataclass

@@ -52,8 +52,11 @@ def discover_new_steps(
         if not m or name in seen:
             continue
         d = os.path.join(root, name)
-        # Only pick up completed saves (config.json is written last).
-        if os.path.exists(os.path.join(d, "config.json")):
+        # Only pick up completed saves. save_hf_checkpoint writes
+        # areal_tpu_config.json LAST (models/hf.py:573) and
+        # load_hf_checkpoint prefers it — gating on the HF config.json
+        # would race a half-written checkpoint.
+        if os.path.exists(os.path.join(d, "areal_tpu_config.json")):
             seen.add(name)
             out.append(EvaluationStep(step=int(m.group(1)), ckpt_dir=d))
     return sorted(out, key=lambda s: s.step)
@@ -116,33 +119,49 @@ class AutomaticEvaluator:
 
     # -------------- watcher loop --------------
 
+    def _eval_one(self, step: EvaluationStep) -> bool:
+        try:
+            step.scores = self._run_eval(step)
+            step.status = "done"
+            logger.info(f"eval step {step.step}: {step.scores}")
+            if self.writer is not None:
+                metrics = {
+                    f"eval/{k}": v
+                    for k, v in (step.scores or {}).items()
+                    if isinstance(v, (int, float))
+                }
+                self.writer.log(metrics, step=step.step)
+            return True
+        except Exception as e:  # noqa: BLE001 — eval must not kill training
+            step.status = "failed"
+            logger.error(f"eval step {step.step} failed: {e}")
+            return False
+
     def poll_once(self) -> int:
-        """Discover + evaluate new checkpoints; returns #evaluated."""
+        """Discover + evaluate new checkpoints; returns #evaluated.
+
+        Up to ``max_concurrent_jobs`` evals run concurrently (reference
+        AutomaticEvaluator runs EvaluationSteps in parallel); failed evals
+        count toward the per-poll cap so a flaky checkpoint can't retry
+        unboundedly within one poll.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
         fresh = discover_new_steps(self.save_dir, self.role, self._seen)
         self.steps.extend(fresh)
-        n = 0
-        for step in self.steps:
-            if step.status != "pending":
-                continue
-            step.status = "running"
-            try:
-                step.scores = self._run_eval(step)
-                step.status = "done"
-                n += 1
-                logger.info(f"eval step {step.step}: {step.scores}")
-                if self.writer is not None:
-                    metrics = {
-                        f"eval/{k}": v
-                        for k, v in (step.scores or {}).items()
-                        if isinstance(v, (int, float))
-                    }
-                    self.writer.log(metrics, step=step.step)
-            except Exception as e:  # noqa: BLE001 — eval must not kill training
-                step.status = "failed"
-                logger.error(f"eval step {step.step} failed: {e}")
-            if n >= self.cfg.max_concurrent_jobs:
-                break
-        return n
+        pending = [s for s in self.steps if s.status == "pending"]
+        cap = max(1, self.cfg.max_concurrent_jobs)
+        batch = pending[:cap]
+        if not batch:
+            return 0
+        for s in batch:
+            s.status = "running"
+        if len(batch) == 1:
+            return int(self._eval_one(batch[0]))
+        with ThreadPoolExecutor(max_workers=cap,
+                                thread_name_prefix="eval") as pool:
+            results = list(pool.map(self._eval_one, batch))
+        return sum(results)
 
     def run_forever(self) -> None:
         while not self._stop.is_set():

@@ -115,9 +115,7 @@ def _child_init(exp_cfg, force_cpu: bool, chips: Optional[List[int]] = None) -> 
     C.setup_name_resolve(exp_cfg)
     # Registration side effects for every factory the configs reference.
     import areal_tpu.agents.math_single_step  # noqa: F401
-    import areal_tpu.algorithms.ppo  # noqa: F401
-    import areal_tpu.algorithms.reward  # noqa: F401
-    import areal_tpu.algorithms.sft  # noqa: F401
+    import areal_tpu.algorithms  # noqa: F401 — registers all interfaces
     import areal_tpu.backend.jax_train  # noqa: F401
     import areal_tpu.datasets.jsonl  # noqa: F401
 
@@ -346,10 +344,26 @@ class LocalLauncher:
         if getattr(exp, "auto_eval", False):
             from areal_tpu.apps.evaluator import AutomaticEvaluator
 
+            from areal_tpu.api.cli_args import AutomaticEvaluatorConfig
+
             eval_data = exp.auto_eval_config.data_names
+            default_names = AutomaticEvaluatorConfig().data_names
             if not os.path.isfile(eval_data):
-                # The reference names vendored benchmark sets; here any
-                # prompt jsonl works — default to the training set's path.
+                if eval_data and eval_data != default_names:
+                    # An explicitly-set eval set that doesn't exist is a
+                    # config error: silently scoring the TRAIN set would
+                    # masquerade as held-out accuracy.
+                    raise FileNotFoundError(
+                        f"auto_eval_config.data_names={eval_data!r} does not "
+                        f"exist; point it at a prompt jsonl (the default "
+                        f"{default_names!r} falls back to the training set)"
+                    )
+                logger.warning(
+                    "auto_eval_config.data_names=%r is not a local file — "
+                    "evaluator will score the TRAINING dataset (%s); "
+                    "eval/* metrics are NOT held-out numbers",
+                    eval_data, exp.dataset.path,
+                )
                 eval_data = exp.dataset.path
             evaluator = AutomaticEvaluator(
                 exp.auto_eval_config,

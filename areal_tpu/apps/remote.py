@@ -92,8 +92,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                     default=_env_int("SLURM_PROCID", 0))
     ap.add_argument("--world", type=int,
                     default=_env_int("SLURM_NTASKS", 1))
-    ap.add_argument("--index", type=int, default=0,
-                    help="worker index within the role group (rollout)")
+    ap.add_argument("--index", type=int,
+                    default=_env_int("SLURM_PROCID", 0),
+                    help="worker index within the role group (rollout); "
+                         "defaults to SLURM_PROCID inside srun tasks")
     ap.add_argument("--force-cpu", action="store_true")
     args = ap.parse_args(argv)
 

@@ -114,21 +114,68 @@ class SlurmClient:
         logger.info(f"submitted {spec.name} as slurm job {job_id}")
         return job_id
 
+    def _sacct_states(self, ids: List[str]) -> Dict[str, str]:
+        """Terminal states for jobs that already left the queue. sacct may
+        be unavailable (no accounting storage) — then we can't do better
+        than COMPLETED."""
+        try:
+            r = self.runner(
+                ["sacct", "-j", ",".join(ids), "-n", "-X", "-P",
+                 "-o", "JobID,State"],
+                capture_output=True, text=True, timeout=120,
+            )
+        except Exception as e:  # noqa: BLE001 — sacct is best-effort
+            logger.warning(f"sacct unavailable: {e}")
+            return {}
+        if r.returncode != 0:
+            logger.warning(f"sacct rc={r.returncode}: {r.stderr.strip()}")
+            return {}
+        out = {}
+        for line in r.stdout.strip().splitlines():
+            parts = line.split("|")
+            if len(parts) >= 2:
+                # "CANCELLED by 1234" → CANCELLED
+                out[parts[0]] = parts[1].split()[0] if parts[1] else ""
+        return out
+
     def states(self) -> Dict[str, str]:
-        """name -> slurm state; jobs that left the queue are COMPLETED
-        unless sacct reports otherwise."""
+        """name -> slurm state. Jobs absent from squeue are checked against
+        sacct to distinguish COMPLETED from FAILED/OOM (a crashed job ages
+        out of squeue after MinJobAge and must not read as success)."""
         if not self.jobs:
             return {}
         ids = ",".join(self.jobs.values())
-        r = self._run(["squeue", "-j", ids, "-h", "-o", "%i %T"])
+        # squeue exits nonzero ("Invalid job id specified") when ANY listed
+        # id has been purged, reporting nothing about the others — retry
+        # per-id in that case so one purged job can't mask still-RUNNING
+        # ones as complete.
+        r = self.runner(["squeue", "-j", ids, "-h", "-o", "%i %T"],
+                        capture_output=True, text=True, timeout=120)
         by_id = {}
-        for line in r.stdout.strip().splitlines():
-            parts = line.split()
-            if len(parts) >= 2:
-                by_id[parts[0]] = parts[1]
+        if r.returncode == 0:
+            for line in r.stdout.strip().splitlines():
+                parts = line.split()
+                if len(parts) >= 2:
+                    by_id[parts[0]] = parts[1]
+        elif "invalid job id" not in (r.stderr or "").lower():
+            raise RuntimeError(
+                f"squeue failed rc={r.returncode}: {r.stderr}"
+            )
+        else:
+            for jid in self.jobs.values():
+                ri = self.runner(["squeue", "-j", jid, "-h", "-o", "%i %T"],
+                                 capture_output=True, text=True, timeout=120)
+                if ri.returncode != 0:
+                    continue  # purged — sacct below decides its fate
+                for line in ri.stdout.strip().splitlines():
+                    parts = line.split()
+                    if len(parts) >= 2:
+                        by_id[parts[0]] = parts[1]
+        gone = [jid for jid in self.jobs.values() if jid not in by_id]
+        sacct = self._sacct_states(gone) if gone else {}
         out = {}
         for name, jid in self.jobs.items():
-            out[name] = by_id.get(jid, "COMPLETED")
+            out[name] = by_id.get(jid) or sacct.get(jid) or "COMPLETED"
         return out
 
     def wait(
@@ -205,9 +252,12 @@ def build_job_specs(exp_cfg, config_path: str) -> List[SlurmJobSpec]:
             mem_per_task_mb=64 * 1024,
         ))
         n_rollout = max(1, getattr(exp_cfg, "n_rollout_workers", 1))
+        # No --index flag: the sbatch batch shell would expand $SLURM_PROCID
+        # before srun spawns tasks (always 0). remote.py defaults --index
+        # from the SLURM_PROCID env inside each srun task instead.
         specs.append(SlurmJobSpec(
             name=f"{exp}-rollout",
-            cmd=f"{base} --role rollout --index $SLURM_PROCID",
+            cmd=f"{base} --role rollout",
             ntasks=n_rollout,
         ))
     return specs

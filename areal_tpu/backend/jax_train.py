@@ -71,15 +71,71 @@ def build_lr_schedule(cfg: OptimizerConfig, total_steps: int):
     return decay
 
 
+def scale_by_adam_mixed(
+    b1: float, b2: float, eps: float,
+    mu_dtype: Optional[str] = None, nu_dtype: Optional[str] = None,
+) -> optax.GradientTransformation:
+    """optax.scale_by_adam with BOTH moment storage dtypes configurable
+    (optax only exposes mu_dtype). The moment math always runs in f32 —
+    only the carried state is cast — so bf16 storage adds rounding noise
+    to the state, not to any single update's arithmetic. Reuses optax's
+    ScaleByAdamState so checkpointed optimizer trees stay compatible."""
+
+    def _cast(tree, dtype):
+        if dtype is None:
+            return tree
+        dt = jnp.dtype(dtype)
+        return jax.tree.map(lambda x: x.astype(dt), tree)
+
+    def init(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
+        )
+        nu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params
+        )
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu
+        )
+
+    def update(updates, state, params=None):
+        del params
+        f32 = jnp.float32
+        mu = jax.tree.map(
+            lambda g, m: b1 * m.astype(f32) + (1 - b1) * g.astype(f32),
+            updates, state.mu,
+        )
+        nu = jax.tree.map(
+            lambda g, n: b2 * n.astype(f32) + (1 - b2) * g.astype(f32) ** 2,
+            updates, state.nu,
+        )
+        count = state.count + 1
+        bc1 = 1 - b1 ** count.astype(f32)
+        bc2 = 1 - b2 ** count.astype(f32)
+        out = jax.tree.map(
+            lambda m, n: (m / bc1) / (jnp.sqrt(n / bc2) + eps), mu, nu
+        )
+        return out, optax.ScaleByAdamState(
+            count=count, mu=_cast(mu, mu_dtype), nu=_cast(nu, nu_dtype)
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(
     cfg: OptimizerConfig, total_steps: int
 ) -> Tuple[optax.GradientTransformation, Callable]:
     sched = build_lr_schedule(cfg, total_steps)
     assert cfg.type in ("adamw", "sgd"), cfg.type
     if cfg.type == "adamw":
-        opt = optax.adamw(
-            sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
-            weight_decay=cfg.weight_decay,
+        opt = optax.chain(
+            scale_by_adam_mixed(
+                cfg.beta1, cfg.beta2, cfg.eps,
+                mu_dtype=getattr(cfg, "mu_dtype", None),
+                nu_dtype=getattr(cfg, "nu_dtype", None),
+            ),
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.scale_by_learning_rate(sched),
         )
     else:
         opt = optax.sgd(sched)
@@ -142,6 +198,24 @@ class JaxTrainEngine(TrainableEngine):
             params = psh.shard_params(params, mesh, cfg)
         else:
             params = jax.tree.map(jnp.asarray, params)
+        if opt_cfg is not None:
+            # EXPLICIT f32 master params when training. Without this the
+            # first optimizer step silently promotes bf16 params to f32
+            # anyway (optax's f32 lr scalar infects the update), costing a
+            # retrace and a failed-donation copy on step one — and hiding
+            # the master-dtype decision. f32 masters are also the quality
+            # choice: bf16's ~3 significant digits round away small
+            # Adam updates (the reference's Megatron DistributedOptimizer
+            # keeps f32 masters for the same reason). Compute still runs
+            # in compute_dtype via _cast. (No buffer donation here: the
+            # caller's tree must stay valid — callers that need the
+            # transient peak gone should drop their reference, as
+            # bench.py does.)
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
+            )
         self.params = params
         self.opt_cfg = opt_cfg
         self.tx = None
@@ -289,6 +363,12 @@ class JaxTrainEngine(TrainableEngine):
                 apply = jnp.asarray(True)
             return new_params, new_opt, gnorm, apply
 
+        # Donate params + opt_state (aliased into new_params/new_opt) AND
+        # grads: no output aliases the grad buffers (XLA warns they are
+        # "not usable" as outputs), but donating them still lets the
+        # optimizer's f32 transients reuse those 2 bytes/param in place —
+        # measured on the 16G bench chip, withdrawing the grads donation
+        # OOMs the apply step.
         self._grad_fns[key] = jax.jit(f, donate_argnums=(0, 1, 2))
         return self._grad_fns[key]
 

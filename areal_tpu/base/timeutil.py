@@ -65,3 +65,9 @@ class FrequencyControl:
 
     def load_state_dict(self, d: dict) -> None:
         self._state = FreqState(**d)
+        # last_time is a time.monotonic() from the SAVING process — that
+        # clock restarts at boot, so carrying it over can make elapsed time
+        # negative and suppress freq_sec firing for arbitrarily long.
+        # Restoring re-anchors the time axis at "now" (epoch/step anchors
+        # carry over exactly).
+        self._state.last_time = time.monotonic()

@@ -83,6 +83,9 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                     1, self.max_concurrent_rollouts // self.n_rollout_workers
                 ),
                 seed=self.seed + i,
+                # Async-recovery skiplist lives next to the master's
+                # recover checkpoints (rollout_worker.ConsumedLog).
+                recover_dir=paths["recover"],
             )
             for i in range(self.n_rollout_workers)
         ]

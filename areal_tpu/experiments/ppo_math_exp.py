@@ -83,6 +83,11 @@ class PPOMATHConfig(BaseExperimentConfig):
     group_size: int = 1
     mask_too_long: bool = False
     ref_ema_eta: Optional[float] = None  # ref := eta*actor + (1-eta)*ref
+    # Fuse ref-logprob inference + rule-based reward into ONE DFG node
+    # (reference fused_interface.py "fused-threading"): the TPU-bound ref
+    # forward overlaps the CPU-bound verification. Sync mode only (async
+    # rollout workers already compute rewards off the trainer path).
+    fuse_rew_ref: bool = False
 
     # ---------------- derived pieces ----------------
 
@@ -107,6 +112,7 @@ class PPOMATHConfig(BaseExperimentConfig):
         """n_prompts = train_bs_n_seqs; downstream nodes see
         n_prompts*group_size flattened trajectories."""
         n_traj = n_prompts * self.group_size
+        fuse = self.fuse_rew_ref and self._use_ref and not async_mode
         mfcs: List[MFCDef] = []
         if not async_mode:
             mfcs.append(MFCDef(
@@ -117,15 +123,25 @@ class PPOMATHConfig(BaseExperimentConfig):
                 output_keys=TRAJ_KEYS,
                 n_seqs=n_prompts, mb_spec=self.actor_gen.mb_spec,
             ))
+            if not fuse:
+                mfcs.append(MFCDef(
+                    name="rew_inf", model_name="rew",
+                    interface_type=MFCInterfaceType.INFERENCE,
+                    interface_impl=ModelInterfaceAbstraction("rw_math_code"),
+                    input_keys=("packed_input_ids", "prompt_mask"),
+                    output_keys=("rewards",),
+                    n_seqs=n_traj, mb_spec=self.rew_inf.mb_spec,
+                ))
+        if fuse:
             mfcs.append(MFCDef(
-                name="rew_inf", model_name="rew",
+                name="fused_rew_ref_inf", model_name="ref",
                 interface_type=MFCInterfaceType.INFERENCE,
-                interface_impl=ModelInterfaceAbstraction("rw_math_code"),
+                interface_impl=ModelInterfaceAbstraction("fused_forward"),
                 input_keys=("packed_input_ids", "prompt_mask"),
-                output_keys=("rewards",),
-                n_seqs=n_traj, mb_spec=self.rew_inf.mb_spec,
+                output_keys=("rewards", "packed_ref_logprobs"),
+                n_seqs=n_traj, mb_spec=self.ref_inf.mb_spec,
             ))
-        if self._use_ref:
+        elif self._use_ref:
             mfcs.append(MFCDef(
                 name="ref_inf", model_name="ref",
                 interface_type=MFCInterfaceType.INFERENCE,
@@ -236,20 +252,35 @@ class PPOMATHConfig(BaseExperimentConfig):
                 init=C.model_init_dict(critic),
                 backend_args=C.backend_args_for(critic, spec, total_steps),
             )
+        fuse = self.fuse_rew_ref and self._use_ref and not async_mode
         mfcs: Dict[str, MFCRuntimeConfig] = {}
         if not async_mode:
-            models["rew"] = ModelRoleConfig(init={"null": True}, backend="null")
             mfcs["actor_gen"] = MFCRuntimeConfig(
                 interface="ppo_actor", interface_args={"hp": hp},
                 model_name="actor",
             )
-            mfcs["rew_inf"] = MFCRuntimeConfig(
-                interface="rw_math_code",
-                interface_args={"dataset_path": self.dataset.path,
-                                "group_size": self.group_size},
-                model_name="rew",
+            if not fuse:
+                models["rew"] = ModelRoleConfig(
+                    init={"null": True}, backend="null"
+                )
+                mfcs["rew_inf"] = MFCRuntimeConfig(
+                    interface="rw_math_code",
+                    interface_args={"dataset_path": self.dataset.path,
+                                    "group_size": self.group_size},
+                    model_name="rew",
+                )
+        if fuse:
+            mfcs["fused_rew_ref_inf"] = MFCRuntimeConfig(
+                interface="fused_forward",
+                interface_args={"interfaces": {
+                    "rew": ("rw_math_code",
+                            {"dataset_path": self.dataset.path,
+                             "group_size": self.group_size}),
+                    "ref": ("ref_logprob", {}),
+                }},
+                model_name="ref",
             )
-        if self._use_ref:
+        elif self._use_ref:
             mfcs["ref_inf"] = MFCRuntimeConfig(
                 interface="ref_logprob", model_name="ref"
             )

@@ -576,6 +576,76 @@ def save_hf_checkpoint(
         )
 
 
+def _flatten_pytree(params) -> Dict[str, np.ndarray]:
+    """Nested-dict param pytree → flat {path: leaf} with '/'-joined keys."""
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            out[prefix] = np.asarray(node)
+
+    walk("", params)
+    return out
+
+
+def _unflatten_pytree(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        parts = path.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+def save_native_checkpoint(
+    params, cfg: TransformerConfig, save_dir: str, meta: Optional[dict] = None
+):
+    """The weight-SYNC format: the stacked param pytree saved verbatim as
+    sharded safetensors — no HF-layout transposes, no re-stacking, dtype
+    preserved (bf16 stays 2 bytes). The in-house generation server consumes
+    this directly; HF layout (save_hf_checkpoint) is only needed for
+    external-tooling interop. Replaces the reference's HF-format realloc
+    dir (realhf/system/model_worker.py:1053 DISK path) with a layout that
+    skips its conversion cost on both ends.
+
+    ``areal_tpu_native.json`` is written LAST — it is the completeness
+    sentinel consumers gate on."""
+    os.makedirs(save_dir, exist_ok=True)
+    save_hf_state_dict(_flatten_pytree(params), save_dir)
+    with open(os.path.join(save_dir, "areal_tpu_native.json"), "w") as f:
+        json.dump(
+            {"areal_tpu_config": dataclasses.asdict(cfg), "meta": meta or {},
+             "format": "native-pytree-v1"}, f
+        )
+
+
+def is_native_checkpoint(load_dir: str) -> bool:
+    return os.path.exists(os.path.join(load_dir, "areal_tpu_native.json"))
+
+
+def load_native_checkpoint(load_dir: str):
+    with open(os.path.join(load_dir, "areal_tpu_native.json")) as f:
+        d = json.load(f)
+    cd = d["areal_tpu_config"]
+    if cd.get("moe"):
+        cd["moe"] = MoEConfig(**cd["moe"])
+    cfg = TransformerConfig(**cd)
+    params = _unflatten_pytree(load_hf_state_dict(load_dir))
+    return cfg, params
+
+
+def load_checkpoint_auto(load_dir: str):
+    """Native if the dir is a weight-sync publish, else HF layout."""
+    if is_native_checkpoint(load_dir):
+        return load_native_checkpoint(load_dir)
+    return load_hf_checkpoint(load_dir)
+
+
 def load_hf_checkpoint(load_dir: str):
     acfg_path = os.path.join(load_dir, "areal_tpu_config.json")
     if not os.path.exists(acfg_path):

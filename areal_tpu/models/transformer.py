@@ -169,6 +169,7 @@ def _block(
     cache_write_index: Optional[jnp.ndarray],
     kv_valid: Optional[jnp.ndarray],
     attn_impl: str,
+    allow_ring: bool = True,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], Optional[Dict[str, jnp.ndarray]]]:
     B, T, D = h.shape
     dh = cfg.head_dim
@@ -197,7 +198,8 @@ def _block(
         # divide (e.g. generate()'s unbucketed batch dim) keep the tolerant
         # GSPMD path.
         use_ring = (
-            mesh is not None
+            allow_ring
+            and mesh is not None
             and mesh.shape.get("sp", 1) > 1
             and cfg.sliding_window is None
             and B % (mesh.shape["dp"] * mesh.shape["fsdp"]) == 0
@@ -258,6 +260,52 @@ def _block(
     return constrain(h + mlp, hid), new_kv, aux
 
 
+# ---------------- layer-stack application ----------------
+
+def apply_layer_stack(
+    cfg: TransformerConfig,
+    h: jnp.ndarray,  # [B, T, D]
+    layer_params: Dict[str, jnp.ndarray],  # stacked [L, ...] (any L)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    positions: Optional[jnp.ndarray],
+    attn_impl: str = "auto",
+    remat=False,
+    allow_ring: bool = True,
+):
+    """Run a stacked layer dict over ``h`` via lax.scan (packed mode, no KV
+    out). Returns (h, aux) where aux stacks per-layer MoE scalars ({} for
+    dense). Shared by the GSPMD scan path and the pipeline-parallel stages
+    (parallel/pipeline.py, which passes each stage's LOCAL slice).
+
+    ``remat``: False | True/"full" (recompute the whole layer in backward)
+    | "dots" (save matmul outputs, recompute elementwise/norm/cast —
+    near-free recompute, releases the non-GEMM residuals)."""
+
+    def body(h, lp):
+        h2, _, aux = _block(
+            cfg, h, lp, cos, sin, segment_ids, positions,
+            None, None, None, attn_impl, allow_ring=allow_ring,
+        )
+        return h2, aux
+
+    body = _maybe_checkpoint(body, remat)
+    h, aux = jax.lax.scan(body, h, layer_params)
+    return h, (aux if aux is not None else {})
+
+
+def _maybe_checkpoint(body, remat):
+    if not remat:
+        return body
+    if remat == "dots":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    return jax.checkpoint(body)
+
+
 # ---------------- forward ----------------
 
 def forward(
@@ -273,6 +321,7 @@ def forward(
     remat: bool = False,  # rematerialize each layer in the backward pass
     return_kv: bool = True,  # False in training: don't stack per-layer K/V
     return_aux: bool = False,  # also return MoE aux losses (layer means)
+    pp_microbatches: Optional[int] = None,  # pipeline depth (None = auto)
 ):
     """Returns (output, kv) — or (output, kv, aux) when ``return_aux`` —
     where output is logits [B, T, V] (or values [B, T] for critics) and kv
@@ -295,35 +344,55 @@ def forward(
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rotary_base)
     layer_params = params["layers"]
 
-    def body(h, xs):
-        if decode:
+    if decode:
+        def body(h, xs):
             lp, (kc, vc) = xs
             h2, (kc2, vc2), aux = _block(
                 cfg, h, lp, cos, sin, None, None, (kc, vc),
                 cache_write_index, kv_valid, attn_impl,
             )
             return h2, ((kc2, vc2), aux)
-        lp = xs
-        h2, kv, aux = _block(
-            cfg, h, lp, cos, sin, segment_ids, positions,
-            None, None, None, attn_impl,
-        )
-        return h2, ((kv if return_kv else None), aux)
 
-    if remat and not decode:
-        # HBM-for-FLOPs trade (the reference relies on Megatron activation
-        # checkpointing; here it is one jax.checkpoint over the scan body).
-        body = jax.checkpoint(body)
-    if decode:
         h, ((ks, vs), aux) = jax.lax.scan(
             body, h, (layer_params, (kv_cache["k"], kv_cache["v"]))
         )
+    elif return_kv:
+        def body(h, lp):
+            h2, kv, aux = _block(
+                cfg, h, lp, cos, sin, segment_ids, positions,
+                None, None, None, attn_impl,
+            )
+            return h2, (kv, aux)
+
+        body = _maybe_checkpoint(body, remat)
+        h, ((ks, vs), aux) = jax.lax.scan(body, h, layer_params)
     else:
-        h, (kv, aux) = jax.lax.scan(body, h, layer_params)
-        ks, vs = kv if return_kv else (None, None)
-    # aux ys are stacked per-layer [n_layers]. The optimized total SUMS over
-    # layers (the reference's aux tracker accumulates every MoE layer's
-    # loss); the diagnostic stats are reported as layer means.
+        ks = vs = None
+        from areal_tpu.parallel import pipeline as pp_mod
+
+        mesh = current_mesh()
+        n_micro = pp_mod.pick_pp_microbatches(
+            mesh, cfg, h.shape[0], pp_microbatches
+        )
+        if n_micro is not None:
+            # Real pipeline parallelism: micro-batches stream through the
+            # pp stages via collective permute (parallel/pipeline.py).
+            h, aux = pp_mod.pipeline_apply_layers(
+                cfg, layer_params, h, cos, sin, segment_ids, positions,
+                mesh, n_micro, attn_impl=attn_impl, remat=remat,
+            )
+        else:
+            # remat note: HBM-for-FLOPs trade (the reference relies on
+            # Megatron activation checkpointing; here one jax.checkpoint
+            # over the scan body).
+            h, aux = apply_layer_stack(
+                cfg, h, layer_params, cos, sin, segment_ids, positions,
+                attn_impl=attn_impl, remat=remat,
+            )
+    # aux ys are stacked per-layer [n_layers] (already reduced in the
+    # pipeline path). The optimized total SUMS over layers (the reference's
+    # aux tracker accumulates every MoE layer's loss); the diagnostic stats
+    # are reported as layer means.
     aux = (
         {
             k: (jnp.sum(v) if k == "aux_total" else jnp.mean(v))

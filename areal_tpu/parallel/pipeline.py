@@ -1,0 +1,190 @@
+"""Pipeline parallelism — micro-batch streaming over the mesh's "pp" axis.
+
+Parity target: ``realhf/impl/model/parallelism/pipeline_parallel/`` (the
+PipeInstruction VM + static GPipe/1F1B schedules) and its executor
+``realhf/impl/model/backend/pipe_runner.py:148``. TPU-first re-design: no
+instruction VM, no p2p send/recv threads — the schedule IS a ``lax.scan``
+over pipeline steps inside a ``shard_map`` that is *manual over "pp" only*
+(``axis_names={"pp"}``): each stage holds ``n_layers/pp`` layers of the
+stacked param tree (the "pp"-sharded leading axis, parallel/sharding.py),
+runs them on its resident micro-batch, and hands the activation to the next
+stage with a nearest-neighbour ``lax.ppermute`` riding the ICI ring. The
+dp/fsdp/tp/sp shardings of everything INSIDE a stage stay automatic
+(GSPMD) — stages compose with tensor/data parallelism without any manual
+collectives.
+
+Schedule: GPipe. ``steps = n_micro + pp - 1``; at step ``s`` stage ``k``
+processes micro-batch ``s-k`` (bubble fraction ``(pp-1)/steps``). The
+backward pass needs no schedule code at all: ``ppermute`` has a transpose
+rule, so ``jax.grad`` of this function IS the reverse pipeline, and
+``remat=True`` recomputes each stage's layers in it (GPipe + remat — the
+same memory/compute trade the reference's 1F1B+checkpointing makes;
+a 1F1B variant would only shrink peak activation memory, not the bubble).
+
+Generation (decode mode) intentionally does NOT pipeline: the decode hot
+loop is latency-bound and the generation fleet runs on its own mesh without
+a "pp" axis (SURVEY §2.4 note; the reference's GenerateSchedule exists
+because its trainer must also generate — our async design moves that to
+the server).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.models.config import TransformerConfig
+
+
+def pick_pp_microbatches(
+    mesh: Optional[Mesh],
+    cfg: TransformerConfig,
+    batch: int,
+    requested: Optional[int] = None,
+) -> Optional[int]:
+    """The pipeline-eligibility gate: returns the micro-batch count, or
+    None when the GSPMD scan path should run instead.
+
+    Requirements: a "pp" axis > 1, layers divisible across stages, a batch
+    divisible into >= pp micro-batches, and sp == 1 (ring attention runs
+    its own shard_map; composing it inside a manual-pp region is future
+    work — such meshes fall back to GSPMD layer sharding, which is correct,
+    just not pipelined).
+    """
+    if mesh is None:
+        return None
+    pp = mesh.shape.get("pp", 1)
+    if pp <= 1 or mesh.shape.get("sp", 1) > 1:
+        return None
+    if cfg.n_layers % pp != 0:
+        return None
+    if requested is not None:
+        n_micro = requested
+        if batch % n_micro != 0:
+            return None
+        return n_micro
+    # Auto: the largest divisor of the batch in [pp, 2*pp] — >= pp keeps
+    # the bubble <= 1/2; > 2*pp only shrinks it further at more dispatch.
+    for n_micro in range(min(2 * pp, batch), 0, -1):
+        if batch % n_micro == 0 and n_micro >= pp:
+            return n_micro
+    return None  # batch too small to feed every stage
+
+
+def pipeline_apply_layers(
+    cfg: TransformerConfig,
+    layer_params: Dict[str, jnp.ndarray],  # stacked [L, ...], "pp"-sharded
+    h: jnp.ndarray,  # [B, T, D]
+    cos: jnp.ndarray,  # [B, T, dh]
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],  # [B, T]
+    positions: Optional[jnp.ndarray],  # [B, T]
+    mesh: Mesh,
+    n_micro: int,
+    attn_impl: str = "auto",
+    remat: bool = False,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Run the stacked layers as a ``pp``-stage GPipe pipeline.
+
+    Returns (h, aux) matching apply_layer_stack: aux values are reduced so
+    that downstream's sum/mean post-processing is an identity — aux_total =
+    sum over all layers (averaged over micro-batches), others = mean over
+    layers (averaged over micro-batches).
+    """
+    from areal_tpu.models import transformer as tfm
+
+    pp = mesh.shape["pp"]
+    B, T, D = h.shape
+    assert B % n_micro == 0 and cfg.n_layers % pp == 0
+    mb = B // n_micro
+    steps = n_micro + pp - 1
+
+    def to_mbs(x):
+        return x.reshape((n_micro, mb) + x.shape[1:]) if x is not None else None
+
+    h_mbs = to_mbs(h)
+    cos_mbs, sin_mbs = to_mbs(cos), to_mbs(sin)
+    seg_mbs = to_mbs(segment_ids)
+    pos_mbs = to_mbs(positions)
+
+    def stage_body(local_layers, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs):
+        stage = jax.lax.axis_index("pp")
+        fwd_perm = [(k, k + 1) for k in range(pp - 1)]
+
+        def step(carry, s):
+            state, aux_acc = carry
+            # Stage 0 ingests micro-batch s; others consume the activation
+            # permuted from their predecessor at the previous step.
+            mb_idx = jnp.clip(s - stage, 0, n_micro - 1)
+            take = lambda x: (
+                jax.lax.dynamic_index_in_dim(x, mb_idx, 0, keepdims=False)
+                if x is not None else None
+            )
+            inp = jax.lax.dynamic_index_in_dim(
+                h_mbs, jnp.clip(s, 0, n_micro - 1), 0, keepdims=False
+            )
+            x = jnp.where(stage == 0, inp, state)
+            y, aux = tfm.apply_layer_stack(
+                cfg, x, local_layers, take(cos_mbs), take(sin_mbs),
+                take(seg_mbs), take(pos_mbs), attn_impl=attn_impl,
+                remat=remat, allow_ring=False,
+            )
+            # Bubble steps run garbage (their ys are never sliced out);
+            # MoE aux must not count them.
+            valid = ((s - stage >= 0) & (s - stage < n_micro)).astype(
+                jnp.float32
+            )
+            aux_acc = {
+                k: aux_acc[k] + valid * jnp.sum(v.astype(jnp.float32))
+                for k, v in aux.items()
+            } if aux else aux_acc
+            state = jax.lax.ppermute(y, "pp", fwd_perm)
+            return (state, aux_acc), y
+
+        aux0 = {
+            k: jnp.zeros((), jnp.float32)
+            for k in ("aux_total", "load_balance_loss", "z_loss",
+                      "dropped_frac")
+        } if cfg.moe is not None else {}
+        state0 = jnp.zeros((mb, T, D), h_mbs.dtype)
+        (_, aux_acc), ys = jax.lax.scan(
+            step, (state0, aux0), jnp.arange(steps)
+        )
+        # Per-stage aux sums -> totals over all layers/micro-batches.
+        aux_out = {
+            k: jax.lax.psum(v, "pp") for k, v in aux_acc.items()
+        }
+        return ys, aux_out
+
+    # Manual over "pp" ONLY: layer stacks arrive as local [L/pp, ...]
+    # slices; activations stay full-shaped with dp/fsdp/tp handled by
+    # GSPMD inside each stage.
+    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    n_opt = 4  # cos/sin/segs/pos
+    ys, aux = jax.shard_map(
+        stage_body,
+        mesh=mesh,
+        in_specs=(layer_specs, P()) + (P(),) * n_opt,
+        out_specs=(P("pp"), P()),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )(layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs)
+
+    # ys is the per-stage step outputs concatenated over "pp":
+    # [pp*steps, mb, T, D]; the finished micro-batch i left the LAST stage
+    # at step (pp-1) + i.
+    last = (pp - 1) * steps + (pp - 1)
+    out = jax.lax.dynamic_slice_in_dim(ys, last, n_micro, axis=0)
+    out = out.reshape(B, T, D)
+
+    if aux:
+        n_layers = float(cfg.n_layers)
+        aux = {
+            k: v / n_micro if k == "aux_total" else v / (n_layers * n_micro)
+            for k, v in aux.items()
+        }
+    return out, aux

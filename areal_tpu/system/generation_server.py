@@ -293,7 +293,7 @@ class GenerationServer:
 
         from areal_tpu.models import hf as hfmod
 
-        _, params = hfmod.load_hf_checkpoint(path)
+        _, params = hfmod.load_checkpoint_auto(path)
         # Preserve the existing per-leaf device placement/sharding.
         return jax.tree.map(
             lambda old, npv: jax.device_put(

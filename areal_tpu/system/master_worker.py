@@ -96,7 +96,14 @@ class MasterWorker:
 
     def setup(self) -> None:
         from areal_tpu.base import monitor
+        from areal_tpu.system.worker_base import WorkerControl
 
+        # Lifecycle FSM endpoint (reference worker_base.py:474): the
+        # launcher/operator can pause/resume/exit/status this worker
+        # between training steps.
+        self.ctrl = WorkerControl(
+            self.cfg.experiment, self.cfg.trial, "master"
+        )
         self.stream = MasterRequestStream(
             self.cfg.experiment, self.cfg.trial, [self.cfg.trainer_handler]
         )
@@ -127,6 +134,10 @@ class MasterWorker:
             return
         self.step = info.last_step_info.global_step
         self.epoch = info.last_step_info.epoch
+        if info.save_ctl_states.get("save"):
+            self._save_ctl.load_state_dict(info.save_ctl_states["save"])
+        if info.ckpt_ctl_states.get("ckpt"):
+            self._ckpt_ctl.load_state_dict(info.ckpt_ctl_states["ckpt"])
         reply = self.stream.call(
             self.cfg.trainer_handler, "restore", {"dir": ckpt}
         )
@@ -146,6 +157,11 @@ class MasterWorker:
         si = recover.StepInfo(self.epoch, self.step, self.step)
         recover.dump(self.cfg.recover_dir, recover.RecoverInfo(
             recover_start=si, last_step_info=si,
+            # Frequency-controller states: without them a recovered run
+            # re-anchors its save/ckpt cadence at the restart point
+            # (reference RecoverInfo.save_ctl_states, recover.py:26).
+            save_ctl_states={"save": self._save_ctl.state_dict()},
+            ckpt_ctl_states={"ckpt": self._ckpt_ctl.state_dict()},
         ))
         # GC old recover ckpts (they are large: params + optimizer state).
         import os
@@ -279,6 +295,14 @@ class MasterWorker:
         self.setup()
         t_start = time.monotonic()
         while not self.should_stop():
+            # Serve the control channel between steps; pause blocks here.
+            await asyncio.to_thread(
+                self.ctrl.step,
+                lambda: {"step": self.step, "epoch": self.epoch},
+            )
+            if self.ctrl.should_exit:
+                logger.info("master: exit requested via control channel")
+                break
             t0 = time.monotonic()
             await self._execute_step()
             self.step += 1
@@ -320,6 +344,7 @@ class MasterWorker:
             self.stream.call, self.cfg.trainer_handler, "exit"
         )
         self._writer.close()
+        self.ctrl.close()
         return {"steps": self.step, "stats": self._stats_history}
 
     def _request_save(self) -> None:

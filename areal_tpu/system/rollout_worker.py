@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -50,6 +51,38 @@ class RolloutWorkerConfig:
     seed: int = 1
     tokenizer: Any = None
     max_rollouts: Optional[int] = None  # stop after N (tests); None = forever
+    # Async-mode recovery: consumed prompt uids are appended to
+    # {recover_dir}/rollout_consumed_{index}.log; a restarted worker skips
+    # them so recovered runs don't re-train the same prompts (reference
+    # rollout_worker.py:180-184 hash_vals_to_ignore skiplist).
+    recover_dir: str = ""
+
+
+class ConsumedLog:
+    """Append-only consumed-uid log for async recovery. One file per
+    rollout worker; crash-safe because lines are tiny appends."""
+
+    def __init__(self, recover_dir: str, worker_index: int):
+        self.path = (
+            os.path.join(recover_dir, f"rollout_consumed_{worker_index}.log")
+            if recover_dir else None
+        )
+        self.seen = set()
+        if self.path and os.path.exists(self.path):
+            with open(self.path) as f:
+                self.seen = {ln.strip() for ln in f if ln.strip()}
+
+    def __contains__(self, uid: str) -> bool:
+        return uid in self.seen
+
+    def add(self, uid: str) -> None:
+        if uid in self.seen:
+            return
+        self.seen.add(uid)
+        if self.path:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(uid + "\n")
 
 
 class RolloutWorker:
@@ -66,6 +99,7 @@ class RolloutWorker:
         from areal_tpu.agents.math_single_step import MathCodeSingleStepEnv
 
         self.env = MathCodeSingleStepEnv(self.id2info)
+        self.consumed = ConsumedLog(cfg.recover_dir, cfg.worker_index)
         self._done = 0
         self._pushed = 0
 
@@ -100,21 +134,38 @@ class RolloutWorker:
             task = asyncio.create_task(
                 self.agent.collect_trajectory(prompt, self.env, obs_q, act_q)
             )
-            qid, prompt_ids, _ = await obs_q.get()
-            results = await client.generate_group(
-                list(map(int, prompt_ids)), cfg.gconfig, cfg.group_size,
-                eos_token_id=cfg.eos_token_id,
-            )
             rec_task = rec.get("task", "math")
-            trajs = [
-                trajectory_from_gen(
-                    qid, j, np.asarray(prompt_ids, np.int32), res,
-                    task=rec_task, task_id=RL_TASKS.index(rec_task),
+            # Service the agent's obs→act exchanges until it returns: one
+            # round for single-step agents, num_turns rounds for multi-turn
+            # (reference rollout_worker.py:330 rollout_task loops the same
+            # way via PartialRolloutManager).
+            turn = 0
+            while True:
+                get_obs = asyncio.create_task(obs_q.get())
+                done, _ = await asyncio.wait(
+                    {task, get_obs}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if get_obs not in done:
+                    get_obs.cancel()
+                    break
+                qid, prompt_ids, gconfig = get_obs.result()
+                gconfig = gconfig or cfg.gconfig
+                results = await client.generate_group(
+                    list(map(int, prompt_ids)), gconfig,
+                    gconfig.n if gconfig is not cfg.gconfig else cfg.group_size,
                     eos_token_id=cfg.eos_token_id,
                 )
-                for j, res in enumerate(results)
-            ]
-            await act_q.put(trajs)
+                trajs = [
+                    trajectory_from_gen(
+                        f"{qid}@t{turn}" if turn else qid, j,
+                        np.asarray(prompt_ids, np.int32), res,
+                        task=rec_task, task_id=RL_TASKS.index(rec_task),
+                        eos_token_id=cfg.eos_token_id,
+                    )
+                    for j, res in enumerate(results)
+                ]
+                turn += 1
+                await act_q.put(trajs)
             final = await task
             for t in final:
                 pusher.push(t.as_json_compatible())
@@ -138,7 +189,12 @@ class RolloutWorker:
     async def run_async(self) -> None:
         import aiohttp
 
+        from areal_tpu.system.worker_base import WorkerControl
+
         cfg = self.cfg
+        ctrl = WorkerControl(
+            cfg.experiment, cfg.trial, f"rollout{cfg.worker_index}"
+        )
         mgr_url = name_resolve.wait(
             names.gen_server_manager(cfg.experiment, cfg.trial), timeout=300
         )
@@ -158,9 +214,19 @@ class RolloutWorker:
                         rec, uid, client, pusher, mgr_url, session
                     ):
                         pass
+                    self.consumed.add(uid)
 
             pending = set()
             while cfg.max_rollouts is None or self._done < cfg.max_rollouts:
+                # Control channel between scheduling rounds: pause stops
+                # NEW rollouts from being issued (in-flight ones finish
+                # when resumed); exit drains out of the loop.
+                await asyncio.to_thread(
+                    ctrl.step,
+                    lambda: {"done": self._done, "pushed": self._pushed},
+                )
+                if ctrl.should_exit:
+                    break
                 while len(pending) < cfg.max_concurrent:
                     rec = self.records[pos % len(self.records)]
                     # Epoch passes over a small dataset re-visit the same
@@ -170,12 +236,15 @@ class RolloutWorker:
                     qid = str(rec["query_id"])
                     uid = qid if epoch == 0 else f"{qid}@r{epoch}"
                     pos += 1
+                    if uid in self.consumed:  # recovered run: already pushed
+                        continue
                     pending.add(asyncio.create_task(one(rec, uid)))
                 done, pending = await asyncio.wait(
                     pending, return_when=asyncio.FIRST_COMPLETED
                 )
                 for d in done:
                     d.result()  # surface exceptions
+        ctrl.close()
         logger.info(f"rollout worker done: {self._pushed} trajectories pushed")
 
     def run(self) -> None:

@@ -123,7 +123,7 @@ class TrainerWorker:
             cfg, params, tok = hfmod.load_hf_model(rc.init["hf_dir"])
             return Model(role, (cfg, params), tokenizer=tok)
         if "ckpt_dir" in rc.init:
-            cfg, params = hfmod.load_hf_checkpoint(rc.init["ckpt_dir"])
+            cfg, params = hfmod.load_checkpoint_auto(rc.init["ckpt_dir"])
             return Model(role, (cfg, params))
         if "tiny" in rc.init:  # fabricated test model (reference testing.py)
             import jax
@@ -322,28 +322,49 @@ class TrainerWorker:
         else:
             raise ValueError(f"unknown hook {hook}")
 
-    def _save_role(self, role: str, path: str) -> None:
+    def _save_role(self, role: str, path: str, fmt: str = "hf") -> None:
+        import jax
+        import jax.numpy as jnp
+
         from areal_tpu.models import hf as hfmod
         from areal_tpu.parallel import distributed as dist
 
         model = self.models[role]
         engine = model.module
-        host_params = dist.allgather_params(engine.params)
+        params = engine.params
+        if fmt == "native":
+            # Weight-sync payloads travel in the COMPUTE dtype (bf16): the
+            # generation fleet computes in bf16 anyway, and casting on
+            # device before the gather halves d2h + disk + h2d bytes vs
+            # shipping the f32 masters.
+            cd = getattr(engine, "compute_dtype", jnp.float32)
+            if cd != jnp.float32:
+                params = jax.tree.map(
+                    lambda x: x.astype(cd)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    params,
+                )
+        host_params = dist.allgather_params(params)
         if not self._rank0:
             return
-        hfmod.save_hf_checkpoint(
+        saver = (hfmod.save_native_checkpoint if fmt == "native"
+                 else hfmod.save_hf_checkpoint)
+        saver(
             host_params, engine.cfg, path,
             meta={"version": model.version.global_step},
         )
 
     def publish_weights(self, role: str) -> None:
-        """The §3.5 weight-sync path: save HF-format weights under the
-        realloc dir and bump names.model_version."""
+        """The §3.5 weight-sync path: save weights under the realloc dir
+        and bump names.model_version. Uses the NATIVE pytree format
+        (models/hf.py save_native_checkpoint) — the generation server is
+        in-house, so the per-version publish skips the HF layout
+        conversion both ways; persistent saves ("save" hooks) stay HF."""
         model = self.models[role]
         version = model.version.global_step
         path = os.path.join(self.cfg.realloc_dir, role, str(version))
         t0 = time.monotonic()
-        self._save_role(role, path)
+        self._save_role(role, path, fmt="native")
         save_secs = time.monotonic() - t0
         if not self._rank0:
             return
@@ -539,10 +560,22 @@ class TrainerWorker:
             )
 
     def run(self) -> None:
+        from areal_tpu.system.worker_base import WorkerControl
+
         self.setup()
         if self._rank0:
+            # Lifecycle FSM endpoint (reference worker_base.py:474); only
+            # rank 0 serves it — pausing rank 0 stalls the whole SPMD group
+            # at the next broadcast, which is exactly pause semantics.
+            ctrl = WorkerControl(
+                self.cfg.experiment, self.cfg.trial, self.cfg.handler
+            )
             while not self._exiting:
+                ctrl.step(lambda: {"roles": sorted(self.models)})
+                if ctrl.should_exit:
+                    break
                 self.serve_once(timeout_ms=100)
+            ctrl.close()
         else:
             while not self._exiting:
                 self._follow_once()

@@ -1,0 +1,196 @@
+"""Worker lifecycle FSM + out-of-band control channel.
+
+Parity target: ``realhf/system/worker_base.py:474`` (Worker FSM
+configure→running→paused→exiting driven by a ZMQ control socket served
+between ``_poll`` iterations, ``WorkerServer`` :71, ``WorkerControlPanel``
+:218) and ``realhf/system/worker_control.py:22-170``.
+
+TPU-shape: workers here are not a class hierarchy — master/trainer/rollout
+loops already exist (system/*.py) and each has a natural per-iteration
+yield point. ``WorkerControl`` is an embeddable control endpoint: the
+worker calls ``control.step(status_fn)`` once per loop iteration; a
+``WorkerControlPanel`` (the launcher, an operator shell, a test) discovers
+workers through name_resolve and sends pause / resume / exit / status /
+reconfigure commands. ``pause`` BLOCKS the worker inside ``step`` until
+resume/exit — the same semantics the reference uses to freeze workers
+during experiment reconfiguration.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("system.worker_base")
+
+
+class WorkerState(str, Enum):
+    CREATED = "created"
+    RUNNING = "running"
+    PAUSED = "paused"
+    EXITING = "exiting"
+
+
+def worker_control_key(experiment: str, trial: str, worker: str) -> str:
+    return f"{names.trial_root(experiment, trial)}/worker_control/{worker}"
+
+
+def worker_control_root(experiment: str, trial: str) -> str:
+    return f"{names.trial_root(experiment, trial)}/worker_control/"
+
+
+class WorkerControl:
+    """Worker-side REP endpoint, served between loop iterations."""
+
+    def __init__(self, experiment: str, trial: str, worker_name: str):
+        self.worker_name = worker_name
+        self.state = WorkerState.CREATED
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        host = network.gethostip()
+        port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
+        name_resolve.add(
+            worker_control_key(experiment, trial, worker_name),
+            f"tcp://{host}:{port}", replace=True,
+        )
+        self._reconfigure_cb: Optional[Callable[[Any], Any]] = None
+        self._t_start = time.monotonic()
+        self._iterations = 0
+
+    def on_reconfigure(self, cb: Callable[[Any], Any]) -> None:
+        """Register the worker's reconfigure handler (payload → result)."""
+        self._reconfigure_cb = cb
+
+    @property
+    def should_exit(self) -> bool:
+        return self.state == WorkerState.EXITING
+
+    def _status(self, status_fn: Optional[Callable[[], Dict]]) -> Dict:
+        d = {
+            "worker": self.worker_name,
+            "state": self.state.value,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "iterations": self._iterations,
+        }
+        if status_fn is not None:
+            try:
+                d.update(status_fn())
+            except Exception as e:  # noqa: BLE001 — status must never kill
+                d["status_error"] = str(e)
+        return d
+
+    def _handle(self, msg: Dict, status_fn) -> Dict:
+        cmd = msg.get("cmd")
+        if cmd == "pause":
+            if self.state == WorkerState.RUNNING:
+                self.state = WorkerState.PAUSED
+            return {"ok": True, "state": self.state.value}
+        if cmd == "resume":
+            if self.state == WorkerState.PAUSED:
+                self.state = WorkerState.RUNNING
+            return {"ok": True, "state": self.state.value}
+        if cmd == "exit":
+            self.state = WorkerState.EXITING
+            return {"ok": True, "state": self.state.value}
+        if cmd == "status":
+            return {"ok": True, **self._status(status_fn)}
+        if cmd == "reconfigure":
+            if self._reconfigure_cb is None:
+                return {"ok": False, "error": "no reconfigure handler"}
+            try:
+                res = self._reconfigure_cb(msg.get("payload"))
+                return {"ok": True, "result": res}
+            except Exception as e:  # noqa: BLE001 — reported to the panel
+                return {"ok": False, "error": str(e)}
+        return {"ok": False, "error": f"unknown command {cmd!r}"}
+
+    def step(
+        self,
+        status_fn: Optional[Callable[[], Dict]] = None,
+        timeout_ms: int = 0,
+    ) -> WorkerState:
+        """Process pending control messages; BLOCK while paused. Call once
+        per worker loop iteration (the reference serves its control socket
+        the same way between _poll calls)."""
+        if self.state == WorkerState.CREATED:
+            self.state = WorkerState.RUNNING
+        self._iterations += 1
+        while True:
+            wait = 200 if self.state == WorkerState.PAUSED else timeout_ms
+            if not self._sock.poll(wait):
+                if self.state == WorkerState.PAUSED:
+                    continue
+                return self.state
+            msg = pickle.loads(self._sock.recv())
+            self._sock.send(pickle.dumps(self._handle(msg, status_fn)))
+            if self.state not in (WorkerState.PAUSED,):
+                return self.state
+
+    def close(self) -> None:
+        self._sock.close(linger=0)
+
+
+class WorkerControlPanel:
+    """Launcher/operator-side client: discover + command workers."""
+
+    def __init__(self, experiment: str, trial: str, timeout: float = 10.0):
+        self.experiment = experiment
+        self.trial = trial
+        self.timeout = timeout
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+
+    def list_workers(self) -> List[str]:
+        root = worker_control_root(self.experiment, self.trial)
+        return sorted(
+            k[len(root):] for k in name_resolve.find_subtree(root)
+        )
+
+    def _sock_for(self, worker: str) -> zmq.Socket:
+        if worker not in self._socks:
+            addr = name_resolve.wait(
+                worker_control_key(self.experiment, self.trial, worker),
+                timeout=self.timeout,
+            )
+            s = self._ctx.socket(zmq.REQ)
+            s.setsockopt(zmq.RCVTIMEO, int(self.timeout * 1000))
+            s.setsockopt(zmq.SNDTIMEO, int(self.timeout * 1000))
+            s.connect(addr)
+            self._socks[worker] = s
+        return self._socks[worker]
+
+    def command(self, worker: str, cmd: str, **kw) -> Dict:
+        s = self._sock_for(worker)
+        s.send(pickle.dumps({"cmd": cmd, **kw}))
+        return pickle.loads(s.recv())
+
+    def pause(self, worker: str) -> Dict:
+        return self.command(worker, "pause")
+
+    def resume(self, worker: str) -> Dict:
+        return self.command(worker, "resume")
+
+    def exit(self, worker: str) -> Dict:
+        return self.command(worker, "exit")
+
+    def status(self, worker: str) -> Dict:
+        return self.command(worker, "status")
+
+    def reconfigure(self, worker: str, payload: Any) -> Dict:
+        return self.command(worker, "reconfigure", payload=payload)
+
+    def pause_all(self) -> Dict[str, Dict]:
+        return {w: self.pause(w) for w in self.list_workers()}
+
+    def resume_all(self) -> Dict[str, Dict]:
+        return {w: self.resume(w) for w in self.list_workers()}
+
+    def close(self) -> None:
+        for s in self._socks.values():
+            s.close(linger=0)

@@ -1,0 +1,140 @@
+"""Agent-level tests with fake obs/act queues (the reference's strategy in
+tests/agent/test_math_single_step_agent.py — drive collect_trajectory
+directly, no rollout worker / generation fleet)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.agents.math_multi_turn import MathMultiTurnAgent
+from areal_tpu.api.agent import EnvironmentService
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base.testing import MockTokenizer
+
+
+class ScriptedEnv(EnvironmentService):
+    """Grades turn t with the scripted verdict list."""
+
+    def __init__(self, verdicts):
+        self.verdicts = list(verdicts)
+        self.calls = 0
+
+    async def step(self, action):
+        ok = self.verdicts[min(self.calls, len(self.verdicts) - 1)]
+        self.calls += 1
+        return None, [1.0 if ok else 0.0], True, {}
+
+
+def _prompt(tok, text="solve 1+1", qid="q0"):
+    ids = tok.encode(text)
+    return SequenceSample.from_default(
+        ids=[qid],
+        data={"packed_prompts": np.asarray(ids, np.int32)},
+        seqlens=[len(ids)],
+    )
+
+
+def _fake_turn_sample(qid, turn, prompt_ids, gen_ids):
+    toks = np.concatenate([prompt_ids, gen_ids]).astype(np.int32)
+    P = len(prompt_ids)
+    return SequenceSample.from_default(
+        ids=[f"{qid}@t{turn}@0"],
+        data={
+            "packed_input_ids": toks,
+            "prompt_mask": np.concatenate(
+                [np.ones(P, np.int32), np.zeros(len(gen_ids), np.int32)]
+            ),
+            "packed_logprobs": np.zeros(len(toks), np.float32),
+            "seq_no_eos_mask": np.asarray([0.0], np.float32),
+            "version_start": np.asarray([0], np.int32),
+            "version_end": np.asarray([0], np.int32),
+        },
+        seqlens=[len(toks)],
+    )
+
+
+async def _drive(agent, env, prompt, gen_text, tok, max_rounds=10):
+    """Bridge like rollout_worker._rollout_one: serve obs until the agent
+    returns; each act is a fake one-sample generation result."""
+    obs_q: asyncio.Queue = asyncio.Queue()
+    act_q: asyncio.Queue = asyncio.Queue()
+    task = asyncio.create_task(
+        agent.collect_trajectory(prompt, env, obs_q, act_q)
+    )
+    seen_obs = []
+    for turn in range(max_rounds):
+        get_obs = asyncio.create_task(obs_q.get())
+        done, _ = await asyncio.wait(
+            {task, get_obs}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if get_obs not in done:
+            get_obs.cancel()
+            break
+        qid, token_ids, gconfig = get_obs.result()
+        seen_obs.append(list(token_ids))
+        await act_q.put([_fake_turn_sample(
+            qid, turn, np.asarray(token_ids, np.int32),
+            np.asarray(tok.encode(gen_text), np.int32),
+        )])
+    return await task, seen_obs
+
+
+def test_multi_turn_retries_until_success_and_discounts():
+    tok = MockTokenizer()
+    agent = MathMultiTurnAgent(
+        tokenizer=tok, num_turns=4, turn_level_discount=0.5,
+    )
+    env = ScriptedEnv([False, False, True])
+    out, seen = asyncio.run(_drive(agent, env, _prompt(tok), "ans", tok))
+    # stopped at the first success: 3 turns, one sample each
+    assert len(out) == 3 and env.calls == 3
+    # turn t+1's context contains turn t's full sequence plus feedback
+    assert len(seen) == 3
+    for a, b in zip(seen, seen[1:]):
+        assert len(b) > len(a)
+        assert b[: len(a)] == a
+    # feedback text is the retry verdict for failed turns
+    assert "wrong" in tok.decode(seen[1][len(seen[0]) :])
+    # rewards: raw per-turn (-1, -1, +1), discounted backwards with 0.5:
+    # r2=+1, r1=-1+0.5*1=-0.5, r0=-1+0.5*(-0.5)=-1.25
+    rs = [float(t.data["rewards"][0]) for t in out]
+    assert rs == pytest.approx([-1.25, -0.5, 1.0])
+
+
+def test_multi_turn_runs_all_turns_when_never_correct():
+    tok = MockTokenizer()
+    agent = MathMultiTurnAgent(
+        tokenizer=tok, num_turns=3, turn_level_discount=1.0,
+    )
+    env = ScriptedEnv([False, False, False])
+    out, seen = asyncio.run(_drive(agent, env, _prompt(tok), "nope", tok))
+    assert len(out) == 3 and len(seen) == 3
+    rs = [float(t.data["rewards"][0]) for t in out]
+    assert rs == pytest.approx([-3.0, -2.0, -1.0])
+    # every turn sample keeps the trajectory key layout (trainable as-is)
+    for t in out:
+        assert "packed_input_ids" in t.data and "rewards" in t.data
+        assert t.data["prompt_mask"].sum() > 0
+
+
+def test_multi_turn_stop_on_success_disabled():
+    tok = MockTokenizer()
+    agent = MathMultiTurnAgent(
+        tokenizer=tok, num_turns=3, stop_on_success=False,
+    )
+    env = ScriptedEnv([True, True, True])
+    out, _ = asyncio.run(_drive(agent, env, _prompt(tok), "yes", tok))
+    assert len(out) == 3
+
+
+def test_multi_turn_answer_log(tmp_path):
+    tok = MockTokenizer()
+    agent = MathMultiTurnAgent(
+        tokenizer=tok, num_turns=2, answer_save_path=str(tmp_path),
+    )
+    env = ScriptedEnv([False, True])
+    asyncio.run(_drive(agent, env, _prompt(tok, qid="q7"), "x", tok))
+    assert (tmp_path / "q7.jsonl").exists()
+    lines = (tmp_path / "q7.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2

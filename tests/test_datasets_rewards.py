@@ -107,6 +107,47 @@ def test_math_extract_and_equal():
     assert math_verify.verify_math("I think \\boxed{7}", ["\\boxed{8}"]) == 0.0
 
 
+def test_math_equal_deep_semantics():
+    """Reference math_parser.py:497 semantic surface: MC letters, the
+    percentage triplet, tuples/intervals, matrices, equations, symbolic."""
+    me = math_verify.math_equal
+    # multiple choice: last standalone letter wins
+    assert me("The answer is (C)", "C")
+    assert me("A or maybe B", "B")
+    assert not me("The answer is (C)", "D")
+    # percentage triplet: ref accepted at 1x, /100, *100
+    assert me("0.5", "50")
+    assert me("50", "0.5")
+    # mixed numbers + \tfrac
+    assert me("1\\frac{1}{2}", "1.5")
+    assert me("\\tfrac{3}{4}", "0.75")
+    assert not me("12/5", "1.4")  # NOT a mixed number
+    # scientific notation
+    assert me("1.5e3", "1500")
+    # tuples / intervals: element-wise, order-sensitive
+    assert me("(1, 2)", "(1,2)")
+    assert me("(\\frac{1}{2}, 3)", "(0.5, 3)")
+    assert not me("(1, 2)", "(2, 1)")
+    assert me("[0, \\pi)", "[0,pi)")
+    # matrices, element-wise
+    assert me(
+        "\\begin{pmatrix}1 & 2\\\\3 & 4\\end{pmatrix}",
+        "\\begin{bmatrix}1 & 2.0\\\\3 & 4\\end{bmatrix}",
+    )
+    assert not me(
+        "\\begin{pmatrix}1 & 2\\\\3 & 4\\end{pmatrix}",
+        "\\begin{pmatrix}1 & 2\\\\3 & 5\\end{pmatrix}",
+    )
+    # equations
+    assert me("x = 5", "5")
+    assert me("5", "y=5")
+    assert me("x + y = 3", "y + x = 3")
+    # symbolic
+    assert me("\\frac{\\sqrt{2}}{2}", "1/\\sqrt{2}")
+    assert me("2x + x", "3x")
+    assert not me("2x", "3x")
+
+
 def test_code_verify_stdin(tmp_path):
     gen = "```python\nx = int(input())\nprint(x + 3)\n```"
     io = {"inputs": ["1\n", "5\n"], "outputs": ["4\n", "8\n"]}

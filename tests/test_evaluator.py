@@ -17,7 +17,9 @@ from areal_tpu.apps.evaluator import (
 def _fake_ckpt(root, role, step):
     d = os.path.join(root, role, f"step{step}")
     os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "config.json"), "w") as f:
+    # save_hf_checkpoint writes areal_tpu_config.json last — it is the
+    # completeness sentinel discover_new_steps gates on.
+    with open(os.path.join(d, "areal_tpu_config.json"), "w") as f:
         json.dump({}, f)
     return d
 
@@ -26,7 +28,7 @@ def test_discover_new_steps_orders_and_dedups(tmp_path):
     root = str(tmp_path)
     _fake_ckpt(root, "actor", 20)
     _fake_ckpt(root, "actor", 5)
-    # incomplete save (no config.json) must be skipped
+    # incomplete save (no areal_tpu_config.json) must be skipped
     os.makedirs(os.path.join(root, "actor", "step99"))
     seen = set()
     steps = discover_new_steps(root, "actor", seen)

@@ -54,6 +54,26 @@ def test_sync_dfg_pruning():
     assert dfg.nodes["critic_train"].interface_type == MFCInterfaceType.TRAIN_STEP
 
 
+def test_sync_dfg_fused_rew_ref():
+    """fuse_rew_ref=True replaces rew_inf + ref_inf with ONE fused node on
+    the ref model (reference fuse_rew_ref semantics); the rew model role
+    disappears from the trainer config."""
+    cfg = _tiny(PPOMATHConfig())
+    CA.apply_overrides(cfg, [
+        "ppo.disable_value=true", "ppo.kl_ctl=0.05", "fuse_rew_ref=true",
+    ])
+    dfg = cfg.build_dfg(4)
+    names = set(dfg.nodes)
+    assert "rew_inf" not in names and "ref_inf" not in names
+    assert "fused_rew_ref_inf" in names
+    node = dfg.nodes["fused_rew_ref_inf"]
+    assert set(node.output_keys) == {"rewards", "packed_ref_logprobs"}
+    assert "packed_ref_logprobs" in dfg.nodes["actor_train"].input_keys
+    tc = cfg.build_trainer_config()
+    assert "rew" not in tc.models and "ref" in tc.models
+    assert tc.mfcs["fused_rew_ref_inf"].interface == "fused_forward"
+
+
 def test_async_dfg_has_no_gen_or_rew():
     cfg = _tiny(AsyncPPOMATHConfig())
     CA.apply_overrides(cfg, [
@@ -81,6 +101,8 @@ def test_initial_setup_generates_worker_configs():
     assert rw.max_concurrent == 4  # 8 // 2 workers
     assert rw.chunk_tokens == 16
     assert rw.gconfig.n == 2  # group_size
+    # async-recovery skiplist must be wired to the recover dir (advisor r5)
+    assert rw.recover_dir and rw.recover_dir == setup["master"].recover_dir
     trainer = setup["trainer"]
     assert trainer.stream_dataset is True
     assert set(trainer.models) == {"actor", "ref"}

@@ -117,6 +117,41 @@ def test_sync_ppo_e2e(math_env):
     assert 0.5 < astats["importance_weight"] < 2.0
 
 
+def test_fused_rew_ref_interface(math_env):
+    """FusedForwardInterface (reference fused_interface.py "fused-
+    threading"): ref-logprob + reward children run concurrently on one MFC
+    and their outputs merge — equal to running them sequentially."""
+    from areal_tpu.algorithms.fused import FusedForwardInterface
+
+    ds, tok, path = math_env
+    hp = PPOHyperparameters(
+        gen=GenerationHyperparameters(max_new_tokens=8), group_size=2,
+    )
+    actor = _make_model("actor_f", seed=0)
+    ref = _make_model("ref_f", seed=0, train=False)
+    actor_i = PPOActorInterface(hp)
+    prompts = SequenceSample.gather([ds[i] for i in range(3)])
+    traj = actor_i.generate(actor, prompts, MBS)
+
+    fused = FusedForwardInterface(interfaces={
+        "rew": ("rw_math_code", {"dataset_path": path, "group_size": 2}),
+        "ref": ("ref_logprob", {}),
+    })
+    out = fused.inference(ref, traj, MBS)
+    assert {"rewards", "packed_ref_logprobs"} <= out.keys
+    assert out.bs == traj.bs
+    # parity with the unfused children
+    seq = LogprobInterface().inference(ref, traj, MBS)
+    np.testing.assert_allclose(
+        out.data["packed_ref_logprobs"], seq.data["packed_ref_logprobs"],
+        atol=1e-5,
+    )
+    rw = MultiTaskRewardInterface(dataset_path=path, group_size=2).inference(
+        Model("rw", None, tokenizer=tok), traj, MBS
+    )
+    np.testing.assert_array_equal(out.data["rewards"], rw.data["rewards"])
+
+
 def test_ppo_decoupled_and_grpo_paths(math_env):
     ds, tok, path = math_env
     hp = PPOHyperparameters(

@@ -1,0 +1,99 @@
+"""Learned reward-model training (algorithms/rw.py): Bradley-Terry loss
+over the RewardModelingPairedDataset — the pairing survives packing, the
+loss optimizes, and the serving path scores flat sequences."""
+
+import json
+
+import jax
+import numpy as np
+
+from areal_tpu.algorithms.rw import (
+    RewardModelingInterface,
+    flatten_pairs,
+)
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model import FinetuneSpec, Model
+from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+from areal_tpu.base.testing import MockTokenizer
+from areal_tpu.datasets.jsonl import RewardModelingPairedDataset
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+MBS = MicroBatchSpec(max_tokens_per_mb=4096)
+
+
+def _paired_jsonl(path, n=16):
+    """Learnable signal: positive answers end in 'G', negatives in 'B'."""
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "query_id": f"q{i}",
+                "prompt": f"question {i}: ",
+                "pos_answers": [f"answer {i} G", f"alt {i} G"],
+                "neg_answers": [f"answer {i} B", f"alt {i} B"],
+            }) + "\n")
+
+
+def _rm_model(seed=0):
+    cfg = tiny_config(vocab_size=258, n_layers=2, hidden_dim=32,
+                      is_critic=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(seed))
+    model = Model("rm", (cfg, params), tokenizer=MockTokenizer())
+    backend = JaxTrainBackend(
+        optimizer=OptimizerConfig(lr=5e-3, lr_scheduler_type="constant",
+                                  warmup_steps_proportion=0.0),
+        compute_dtype="float32", length_bucket=16, rows_bucket=2,
+        seqs_bucket=4,
+    )
+    return backend.initialize(model, FinetuneSpec(1, 64, 8))
+
+
+def test_flatten_pairs_layout(tmp_path):
+    p = tmp_path / "rw.jsonl"
+    _paired_jsonl(str(p), n=4)
+    ds = RewardModelingPairedDataset(dataset_path=str(p),
+                                     tokenizer=MockTokenizer())
+    batch = SequenceSample.gather([ds[i] for i in range(4)])
+    flat = flatten_pairs(batch)
+    # 4 prompts x 2 pairs x 2 answers
+    assert flat.bs == 16
+    signs = flat.data["_pair_sign"].reshape(-1)
+    idxs = flat.data["_pair_idx"].reshape(-1)
+    assert (signs > 0).sum() == 8 and (signs < 0).sum() == 8
+    # every pair id appears exactly once with each sign
+    for pid in np.unique(idxs):
+        ss = signs[idxs == pid]
+        assert sorted(ss.tolist()) == [-1.0, 1.0]
+
+
+def test_rw_training_learns_preference(tmp_path):
+    p = tmp_path / "rw.jsonl"
+    _paired_jsonl(str(p), n=16)
+    ds = RewardModelingPairedDataset(dataset_path=str(p),
+                                     tokenizer=MockTokenizer())
+    model = _rm_model()
+    iface = RewardModelingInterface()
+    batch = SequenceSample.gather([ds[i] for i in range(len(ds))])
+    first = None
+    for _ in range(15):
+        stats = iface.train_step(model, batch, MBS)
+        assert stats["orphan_pairs"] == 0.0
+        assert stats["n_pairs"] == 32.0
+        first = first or stats
+    assert stats["loss"] < first["loss"]
+    assert stats["pairwise_accuracy"] >= 0.9
+    assert stats["pos_minus_neg"] > 0
+
+    # serving path: flat sequences -> scores, pos > neg for a seen pair
+    tok = MockTokenizer()
+    seqs = [tok.encode("question 3: answer 3 G"),
+            tok.encode("question 3: answer 3 B")]
+    flat = SequenceSample.gather([
+        SequenceSample.from_default(
+            ids=[f"s{i}"],
+            data={"packed_input_ids": np.asarray(s, np.int32)},
+            seqlens=[len(s)],
+        ) for i, s in enumerate(seqs)
+    ])
+    out = iface.inference(model, flat, MBS)
+    assert out.data["scores"][0] > out.data["scores"][1]

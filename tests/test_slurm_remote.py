@@ -27,6 +27,7 @@ class FakeSlurm:
         self.next_id = 100
         self.states = {}  # job id -> state
         self.squeue_calls = 0
+        self.sacct_states = {}  # job id -> terminal state for purged jobs
 
     def __call__(self, cmd, capture_output=True, text=True, timeout=None):
         prog = cmd[0]
@@ -39,11 +40,15 @@ class FakeSlurm:
                                                stderr="")
         if prog == "squeue":
             self.squeue_calls += 1
-            # All jobs drop off squeue (= COMPLETED) on the second poll.
+            # All jobs drop off squeue (= left the queue) on the 2nd poll.
             if self.squeue_calls >= 2:
                 lines = []
             else:
                 lines = [f"{j} {s}" for j, s in self.states.items()]
+            return subprocess.CompletedProcess(
+                cmd, 0, stdout="\n".join(lines) + "\n", stderr="")
+        if prog == "sacct":
+            lines = [f"{j}|{s}" for j, s in self.sacct_states.items()]
             return subprocess.CompletedProcess(
                 cmd, 0, stdout="\n".join(lines) + "\n", stderr="")
         if prog == "scancel":
@@ -124,6 +129,79 @@ def test_slurm_client_failure_raises(tmp_path):
     client.submit(SlurmJobSpec(name="bad", cmd="false"))
     with pytest.raises(RuntimeError, match="failed"):
         client.wait(poll_secs=0.01, timeout=5)
+
+
+def test_states_uses_sacct_for_purged_jobs(tmp_path):
+    """A job that crashed and aged out of squeue (MinJobAge) must not read
+    as COMPLETED — sacct has the terminal state."""
+    fake = FakeSlurm()
+    client = SlurmClient(str(tmp_path), runner=fake)
+    client.submit(SlurmJobSpec(name="dead", cmd="false"))
+    fake.squeue_calls = 1  # next squeue poll returns nothing
+    fake.sacct_states[client.jobs["dead"]] = "OUT_OF_MEMORY"
+    assert client.states()["dead"] == "OUT_OF_MEMORY"
+    with pytest.raises(RuntimeError, match="failed"):
+        client.wait(poll_secs=0.01, timeout=5)
+
+
+def test_states_tolerates_squeue_invalid_job_id(tmp_path):
+    """squeue exits nonzero when all listed ids were purged — that is
+    normal completion, not an error."""
+    fake = FakeSlurm()
+
+    def runner(cmd, **kw):
+        if cmd[0] == "squeue":
+            return subprocess.CompletedProcess(
+                cmd, 1, stdout="",
+                stderr="slurm_load_jobs error: Invalid job id specified\n")
+        return fake(cmd, **kw)
+
+    client = SlurmClient(str(tmp_path), runner=runner)
+    client.submit(SlurmJobSpec(name="ok", cmd="true"))
+    assert client.states()["ok"] == "COMPLETED"
+
+
+def test_states_per_id_retry_when_batched_squeue_rejected():
+    """One purged id makes the batched `squeue -j a,b` exit nonzero while
+    saying nothing about the others — a still-RUNNING job must not read as
+    COMPLETED (per-id retry)."""
+    fake = FakeSlurm()
+
+    def runner(cmd, **kw):
+        if cmd[0] == "squeue":
+            jid = cmd[2]
+            if "," in jid:  # batched query: rejected
+                return subprocess.CompletedProcess(
+                    cmd, 1, stdout="",
+                    stderr="slurm_load_jobs error: Invalid job id specified\n")
+            if jid == live_id:
+                return subprocess.CompletedProcess(
+                    cmd, 0, stdout=f"{jid} RUNNING\n", stderr="")
+            return subprocess.CompletedProcess(
+                cmd, 1, stdout="", stderr="Invalid job id specified\n")
+        return fake(cmd, **kw)
+
+    client = SlurmClient("/tmp/slurmlog", runner=runner)
+    client.submit(SlurmJobSpec(name="gone", cmd="true"))
+    client.submit(SlurmJobSpec(name="live", cmd="sleep 100"))
+    live_id = client.jobs["live"]
+    fake.sacct_states[client.jobs["gone"]] = "COMPLETED"
+    st = client.states()
+    assert st["live"] == "RUNNING"
+    assert st["gone"] == "COMPLETED"
+
+
+def test_rollout_cmd_has_no_index_flag():
+    """--index $SLURM_PROCID would be expanded by the batch shell (PROCID=0
+    there) before srun fans out; the index must come from the env inside
+    each task instead (remote.py defaults it from SLURM_PROCID)."""
+    cfg = AsyncPPOMATHConfig(
+        experiment_name="e2e", allocation_mode="gen.d4+d2f2t2",
+        n_rollout_workers=3,
+    )
+    specs = {s.name: s for s in build_job_specs(cfg, "/c.yaml")}
+    assert "--index" not in specs["e2e-rollout"].cmd
+    assert "SLURM_PROCID" not in specs["e2e-rollout"].cmd
 
 
 def test_slurm_launcher_end_to_end(tmp_path, tmp_name_resolve):

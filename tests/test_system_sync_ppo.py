@@ -199,8 +199,12 @@ def test_sync_ppo_through_fabric(tmp_path):
         # weight publishing happened: version key exists + ckpt on disk
         v = name_resolve.get(names.model_version(EXP, TRIAL, "actor"))
         assert int(v) >= 1
+        # publish_weights writes the NATIVE pytree format; the json
+        # sentinel is written last (models/hf.py save_native_checkpoint).
         assert os.path.exists(os.path.join(realloc_dir, "actor", v,
-                                           "model.npz"))
+                                           "areal_tpu_native.json"))
+        assert os.path.exists(os.path.join(realloc_dir, "actor", v,
+                                           "model.safetensors"))
         proc.join(timeout=30)
         assert proc.exitcode == 0
     finally:

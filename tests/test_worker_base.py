@@ -1,0 +1,139 @@
+"""Worker lifecycle FSM + control channel (system/worker_base.py; reference
+worker_base.py:474 configure→running→paused→exiting semantics)."""
+
+import threading
+import time
+
+from areal_tpu.system.worker_base import (
+    WorkerControl,
+    WorkerControlPanel,
+    WorkerState,
+)
+
+EXP, TRIAL = "wbexp", "t0"
+
+
+def _loop_worker(name, counter, stop_evt, reconfigured):
+    ctrl = WorkerControl(EXP, TRIAL, name)
+    ctrl.on_reconfigure(lambda payload: reconfigured.append(payload) or "ok")
+    while not stop_evt.is_set():
+        ctrl.step(lambda: {"count": counter[0]})
+        if ctrl.should_exit:
+            break
+        counter[0] += 1
+        time.sleep(0.005)
+    ctrl.close()
+
+
+def test_pause_resume_status_exit(tmp_name_resolve):
+    counter = [0]
+    stop = threading.Event()
+    reconf = []
+    t = threading.Thread(
+        target=_loop_worker, args=("w0", counter, stop, reconf), daemon=True
+    )
+    t.start()
+    panel = WorkerControlPanel(EXP, TRIAL)
+    try:
+        st = panel.status("w0")
+        assert st["ok"] and st["state"] == WorkerState.RUNNING.value
+        assert st["worker"] == "w0" and "uptime_s" in st
+
+        # pause: the loop must stop advancing
+        assert panel.pause("w0")["state"] == WorkerState.PAUSED.value
+        time.sleep(0.05)
+        frozen = panel.status("w0")["count"]
+        time.sleep(0.1)
+        assert panel.status("w0")["count"] == frozen
+        assert panel.status("w0")["state"] == WorkerState.PAUSED.value
+
+        # reconfigure works while paused (the reference's reason for pause)
+        r = panel.reconfigure("w0", {"lr": 1e-4})
+        assert r["ok"] and r["result"] == "ok"
+        assert reconf == [{"lr": 1e-4}]
+
+        # resume: it advances again
+        assert panel.resume("w0")["state"] == WorkerState.RUNNING.value
+        time.sleep(0.1)
+        assert panel.status("w0")["count"] > frozen
+
+        # discovery
+        assert panel.list_workers() == ["w0"]
+
+        # exit: thread drains
+        panel.exit("w0")
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        stop.set()
+        panel.close()
+
+
+def test_consumed_log_roundtrip(tmp_path):
+    """Async-recovery skiplist (rollout_worker.ConsumedLog): a restarted
+    worker must skip uids consumed before the crash."""
+    from areal_tpu.system.rollout_worker import ConsumedLog
+
+    log = ConsumedLog(str(tmp_path), worker_index=2)
+    assert "q1" not in log
+    log.add("q1")
+    log.add("q2@r1")
+    log.add("q1")  # idempotent
+    assert "q1" in log and "q2@r1" in log
+
+    # "restart": a fresh instance reads the same file
+    log2 = ConsumedLog(str(tmp_path), worker_index=2)
+    assert "q1" in log2 and "q2@r1" in log2 and "q3" not in log2
+    # a different worker index has its own log
+    other = ConsumedLog(str(tmp_path), worker_index=3)
+    assert "q1" not in other
+    # no recover dir -> in-memory only
+    mem = ConsumedLog("", worker_index=0)
+    mem.add("x")
+    assert "x" in mem
+
+
+def test_freq_ctl_state_roundtrip():
+    """RecoverInfo freq-ctl states: a restored controller keeps its
+    last-fired anchors instead of re-firing immediately."""
+    from areal_tpu.base.timeutil import FrequencyControl
+
+    c = FrequencyControl(freq_step=5)
+    assert not c.check(epochs=0, steps=3)
+    assert c.check(epochs=0, steps=5)
+    st = c.state_dict()
+    c2 = FrequencyControl(freq_step=5)
+    c2.load_state_dict(st)
+    assert not c2.check(epochs=0, steps=6)
+    assert c2.check(epochs=0, steps=10)
+
+
+def test_multiple_workers_discovered(tmp_name_resolve):
+    stop = threading.Event()
+    threads = []
+    for i in range(3):
+        t = threading.Thread(
+            target=_loop_worker, args=(f"w{i}", [0], stop, []), daemon=True
+        )
+        t.start()
+        threads.append(t)
+    panel = WorkerControlPanel(EXP, TRIAL)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if len(panel.list_workers()) == 3:
+                break
+            time.sleep(0.02)
+        assert panel.list_workers() == ["w0", "w1", "w2"]
+        states = panel.pause_all()
+        assert all(v["state"] == "paused" for v in states.values())
+        states = panel.resume_all()
+        assert all(v["state"] == "running" for v in states.values())
+        for w in panel.list_workers():
+            panel.exit(w)
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive()
+    finally:
+        stop.set()
+        panel.close()
