@@ -330,7 +330,10 @@ class PPOActorInterface(ModelInterface):
         hp_ = self.hp
 
         def actor_loss_fn(logits, batch):
-            lp = F.token_logprobs_from_logits(
+            # With the engine's chunked-logprob head (wants_token_logprobs)
+            # this receives the [B, L] logprobs directly; otherwise raw
+            # [B, L, V] logits.
+            lp = logits if logits.ndim == 2 else F.token_logprobs_from_logits(
                 logits, batch["tokens"], batch["segment_ids"]
             )
             amask = F.action_token_mask(
@@ -352,6 +355,7 @@ class PPOActorInterface(ModelInterface):
             stats["n_action_tokens"] = jnp.sum(amask)
             return loss, stats
 
+        actor_loss_fn.wants_token_logprobs = True
         self._loss_fn = actor_loss_fn
         self._prep_fn = make_advantage_prep(self.hp)
 
@@ -528,9 +532,14 @@ class PPOActorInterface(ModelInterface):
 
 
 def _logprob_hook(logits, batch):
+    if logits.ndim == 2:  # engine's chunked-logprob head already did it
+        return logits
     return F.token_logprobs_from_logits(
         logits, batch["tokens"], batch["segment_ids"]
     )
+
+
+_logprob_hook.wants_token_logprobs = True
 
 
 def _values_hook(values, batch):

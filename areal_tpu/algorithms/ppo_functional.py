@@ -35,6 +35,25 @@ import numpy as np
 from areal_tpu.ops.xent import gather_logprobs  # noqa: E402,F401  (re-export)
 
 
+def next_token_labels(tokens: jnp.ndarray) -> jnp.ndarray:
+    """labels[t] = tokens[t+1] (last column wraps — masked out later)."""
+    return jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+
+
+def shift_mask_scores(
+    s: jnp.ndarray,  # [B, L]: s[t] = log p(token_{t+1} | logits at t)
+    segment_ids: jnp.ndarray,  # [B, L], 0 = pad
+) -> jnp.ndarray:
+    """Shift-right + same-doc masking: position t ends up holding
+    log p(token_t | prefix), 0 at doc starts and padding."""
+    tok_lp = jnp.concatenate([jnp.zeros_like(s[:, :1]), s[:, :-1]], axis=1)
+    prev_seg = jnp.concatenate(
+        [jnp.zeros_like(segment_ids[:, :1]), segment_ids[:, :-1]], axis=1
+    )
+    valid = (segment_ids > 0) & (prev_seg == segment_ids)
+    return tok_lp * valid
+
+
 def token_logprobs_from_logits(
     logits: jnp.ndarray,  # [B, L, V]
     tokens: jnp.ndarray,  # [B, L]
@@ -44,15 +63,8 @@ def token_logprobs_from_logits(
     model's score of token t from the logits at t−1 within the same doc;
     0 at each doc's first token and on padding. This is the grid version of
     the reference's gather_packed_shifted_log_probs (utils/functional.py)."""
-    # s[t] = logprob of token_{t+1} under logits[t]; then shift right.
-    labels = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
-    s = gather_logprobs(logits, labels)
-    tok_lp = jnp.concatenate([jnp.zeros_like(s[:, :1]), s[:, :-1]], axis=1)
-    prev_seg = jnp.concatenate(
-        [jnp.zeros_like(segment_ids[:, :1]), segment_ids[:, :-1]], axis=1
-    )
-    valid = (segment_ids > 0) & (prev_seg == segment_ids)
-    return tok_lp * valid
+    s = gather_logprobs(logits, next_token_labels(tokens))
+    return shift_mask_scores(s, segment_ids)
 
 
 def action_token_mask(segment_ids, prompt_mask):

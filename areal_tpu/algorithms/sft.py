@@ -19,11 +19,17 @@ from areal_tpu.algorithms import ppo_functional as F
 
 def sft_loss(logits: jnp.ndarray, batch: Dict[str, jnp.ndarray]):
     """Sum of -logp over answer tokens. Token t is scored by logits at t-1
-    (same doc), so the first token of each doc never contributes."""
-    lp = F.token_logprobs_from_logits(logits, batch["tokens"], batch["segment_ids"])
+    (same doc), so the first token of each doc never contributes. Receives
+    precomputed [B, L] logprobs under the engine's chunked-logprob head."""
+    lp = logits if logits.ndim == 2 else F.token_logprobs_from_logits(
+        logits, batch["tokens"], batch["segment_ids"]
+    )
     w = batch["_sft_loss_mask"]
     loss = -jnp.sum(lp * w)
     return loss, {"n_tokens": jnp.sum(w), "nll_sum": loss}
+
+
+sft_loss.wants_token_logprobs = True
 
 
 def _loss_weight(mb) -> float:
@@ -57,10 +63,12 @@ class SFTInterface(ModelInterface):
         data = _attach_loss_mask(data)
 
         def hook(logits, batch):
-            lp = F.token_logprobs_from_logits(
+            lp = logits if logits.ndim == 2 else F.token_logprobs_from_logits(
                 logits, batch["tokens"], batch["segment_ids"]
             )
             return -lp * batch["_sft_loss_mask"]
+
+        hook.wants_token_logprobs = True
 
         per_sample = engine.forward(data, mb_spec, post_hook=_stable(hook))
         import numpy as np

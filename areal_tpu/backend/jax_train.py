@@ -18,6 +18,7 @@ Engine). TPU-first differences:
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -185,6 +186,7 @@ class JaxTrainEngine(TrainableEngine):
         seqs_bucket: int = 8,
         attn_impl: str = "auto",
         remat: bool = False,
+        logprob_chunk: Optional[int] = 512,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -194,6 +196,9 @@ class JaxTrainEngine(TrainableEngine):
         self.seqs_bucket = seqs_bucket
         self.attn_impl = attn_impl
         self.remat = remat
+        # Column-chunk size for the chunked-logprob head (None disables);
+        # only used by losses/hooks that declare wants_token_logprobs.
+        self.logprob_chunk = logprob_chunk
         if mesh is not None:
             params = psh.shard_params(params, mesh, cfg)
         else:
@@ -267,6 +272,56 @@ class JaxTrainEngine(TrainableEngine):
         out = out.astype(jnp.float32) if self.cfg.is_critic else out
         return (out, aux) if with_aux else out
 
+    def _forward_token_logprobs(self, params, batch: Dict[str, jnp.ndarray]):
+        """[R, L] per-token logprobs with a CHUNKED head: the [R, L, V]
+        logits grid never materializes (at a 152k vocab it is the single
+        biggest activation, ~2.4GB at [8,1024] incl. its cotangent — the
+        reason remat had to be on). Each column-chunk computes its logits
+        and gathers its scores under jax.checkpoint, so backward recomputes
+        chunk logits instead of storing them — the head matmul is redone
+        once (~25% of forward FLOPs at 0.5B) to free the grid; role parity:
+        the reference's fused vocab-parallel cross entropy
+        (tensor_parallel/modules.py:1060) exists for the same reason."""
+        from areal_tpu.algorithms import ppo_functional as F
+
+        cast = self._cast(params)
+        h, _, aux = transformer.forward(
+            cast, self.cfg,
+            batch["tokens"], batch["positions"],
+            segment_ids=batch["segment_ids"],
+            attn_impl=self.attn_impl, remat=self.remat,
+            return_kv=False, return_aux=True, return_hidden=True,
+        )
+        R, L, D = h.shape
+        labels = F.next_token_labels(batch["tokens"])
+        C = self.logprob_chunk or L
+        if L % C != 0:
+            C = L  # bucketing guarantees divisibility in practice
+
+        @jax.checkpoint
+        def chunk_scores(h_c, lab_c):
+            logits_c = transformer.apply_head(cast, self.cfg, h_c)
+            from areal_tpu.ops.xent import gather_logprobs
+
+            return gather_logprobs(logits_c, lab_c)
+
+        if C == L:
+            s = chunk_scores(h, labels)
+        else:
+            n = L // C
+            hs = h.reshape(R, n, C, D).transpose(1, 0, 2, 3)
+            ls = labels.reshape(R, n, C).transpose(1, 0, 2)
+            s = jax.lax.map(lambda args: chunk_scores(*args), (hs, ls))
+            s = s.transpose(1, 0, 2).reshape(R, L)
+        return F.shift_mask_scores(s, batch["segment_ids"]), aux
+
+    def _use_chunked_logprobs(self, fn) -> bool:
+        return (
+            self.logprob_chunk is not None
+            and not self.cfg.is_critic
+            and bool(getattr(fn, "wants_token_logprobs", False))
+        )
+
     def _get_grad_fn(self, loss_fn: LossFn, with_carry: bool) -> Callable:
         """Fused grad + accumulate step, one dispatch per micro-batch.
 
@@ -284,11 +339,15 @@ class JaxTrainEngine(TrainableEngine):
         be reused by a new closure after GC and silently run stale code.
         """
         key = (loss_fn, with_carry)
+        use_lp = self._use_chunked_logprobs(loss_fn)
         if key not in self._grad_fns:
 
             def f(params, batch, denom, scale, aux_scale, carry=None):
                 def lf(p):
-                    out, aux = self._model_forward(p, batch, with_aux=True)
+                    if use_lp:
+                        out, aux = self._forward_token_logprobs(p, batch)
+                    else:
+                        out, aux = self._model_forward(p, batch, with_aux=True)
                     loss_sum, stats = loss_fn(out, batch)
                     loss = loss_sum / jnp.maximum(denom, 1.0)
                     if aux:
@@ -450,6 +509,7 @@ class JaxTrainEngine(TrainableEngine):
         per micro-batch) is part of the cache key: two packings can share the
         total grid shape while slicing differently."""
         key = (loss_fn, with_carry, "sliced", R)
+        use_lp = self._use_chunked_logprobs(loss_fn)
         if key not in self._grad_fns:
 
             def f(params, grids, seq, mb_idx, denom, scale, aux_scale,
@@ -464,7 +524,10 @@ class JaxTrainEngine(TrainableEngine):
                     )
 
                 def lf(p):
-                    out, aux = self._model_forward(p, batch, with_aux=True)
+                    if use_lp:
+                        out, aux = self._forward_token_logprobs(p, batch)
+                    else:
+                        out, aux = self._model_forward(p, batch, with_aux=True)
                     loss_sum, stats = loss_fn(out, batch)
                     loss = loss_sum / jnp.maximum(denom, 1.0)
                     if aux:
@@ -669,10 +732,13 @@ class JaxTrainEngine(TrainableEngine):
     def save_train_state(self, ckpt_dir: str) -> None:
         import os
 
+        from safetensors.numpy import save_file
+
         from areal_tpu.parallel import distributed as dist
 
         # Multi-host: every process joins the gather collective; only
-        # process 0 touches the filesystem.
+        # process 0 touches the filesystem. safetensors (not npz): npz
+        # cannot round-trip bf16 leaves (the mixed-dtype Adam moments).
         host_params = dist.allgather_params(self.params)
         host_opt = (
             dist.allgather_params(self.opt_state)
@@ -682,35 +748,52 @@ class JaxTrainEngine(TrainableEngine):
             return
         os.makedirs(ckpt_dir, exist_ok=True)
         p_leaves = jax.tree_util.tree_leaves(host_params)
-        np.savez(
-            os.path.join(ckpt_dir, "params.npz"),
-            **{f"p{i}": np.asarray(x) for i, x in enumerate(p_leaves)},
+        save_file(
+            {f"p{i}": np.ascontiguousarray(x) for i, x in
+             enumerate(p_leaves)},
+            os.path.join(ckpt_dir, "params.safetensors"),
         )
         if host_opt is not None:
             o_leaves = jax.tree_util.tree_leaves(host_opt)
-            np.savez(
-                os.path.join(ckpt_dir, "opt_state.npz"),
-                **{f"o{i}": np.asarray(x) for i, x in enumerate(o_leaves)},
-                opt_step_count=np.asarray(self.opt_step_count),
+            save_file(
+                {
+                    **{f"o{i}": np.ascontiguousarray(x)
+                       for i, x in enumerate(o_leaves)},
+                    "opt_step_count": np.asarray(self.opt_step_count),
+                },
+                os.path.join(ckpt_dir, "opt_state.safetensors"),
             )
 
-    def load_train_state(self, ckpt_dir: str) -> None:
-        import os
+    @staticmethod
+    def _load_leaf_file(path: str) -> Dict[str, np.ndarray]:
+        from safetensors.numpy import load_file
 
-        with np.load(os.path.join(ckpt_dir, "params.npz")) as z:
-            leaves = [z[f"p{i}"] for i in range(len(z.files))]
+        if os.path.exists(path):
+            return load_file(path)
+        legacy = path.replace(".safetensors", ".npz")
+        if os.path.exists(legacy):  # pre-r5 checkpoints
+            with np.load(legacy) as z:
+                return {k: z[k] for k in z.files}
+        raise FileNotFoundError(path)
+
+    def load_train_state(self, ckpt_dir: str) -> None:
+        z = self._load_leaf_file(os.path.join(ckpt_dir, "params.safetensors"))
+        leaves = [z[f"p{i}"] for i in range(len(z))]
         treedef = jax.tree_util.tree_structure(self.params)
         old = jax.tree_util.tree_leaves(self.params)
         self.params = jax.tree_util.tree_unflatten(treedef, [
-            jax.device_put(np.asarray(v, o.dtype), o.sharding)
+            jax.device_put(np.asarray(v).astype(o.dtype), o.sharding)
             for v, o in zip(leaves, old)
         ])
-        opt_path = os.path.join(ckpt_dir, "opt_state.npz")
-        if self.opt_state is not None and os.path.exists(opt_path):
-            with np.load(opt_path) as z:
-                self.opt_step_count = int(z["opt_step_count"])
-                n = len(z.files) - 1
-                o_leaves = [z[f"o{i}"] for i in range(n)]
+        try:
+            z = self._load_leaf_file(
+                os.path.join(ckpt_dir, "opt_state.safetensors")
+            )
+        except FileNotFoundError:
+            z = None
+        if self.opt_state is not None and z is not None:
+            self.opt_step_count = int(z.pop("opt_step_count"))
+            o_leaves = [z[f"o{i}"] for i in range(len(z))]
             treedef = jax.tree_util.tree_structure(self.opt_state)
             old = jax.tree_util.tree_leaves(self.opt_state)
             assert len(old) == len(o_leaves), (
@@ -718,7 +801,7 @@ class JaxTrainEngine(TrainableEngine):
                 f"vs live {len(old)}"
             )
             self.opt_state = jax.tree_util.tree_unflatten(treedef, [
-                jax.device_put(np.asarray(v, o.dtype), o.sharding)
+                jax.device_put(np.asarray(v).astype(o.dtype), o.sharding)
                 for v, o in zip(o_leaves, old)
             ])
 
@@ -738,10 +821,14 @@ class JaxTrainEngine(TrainableEngine):
             rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
         )
         key = id(post_hook)
+        use_lp = self._use_chunked_logprobs(post_hook)
         if key not in self._fwd_fns:
 
             def f(params, batch):
-                out = self._model_forward(params, batch)
+                if use_lp:
+                    out, _ = self._forward_token_logprobs(params, batch)
+                else:
+                    out = self._model_forward(params, batch)
                 return post_hook(out, batch) if post_hook is not None else out
 
             self._fwd_fns[key] = jax.jit(f)
@@ -812,6 +899,7 @@ class JaxTrainBackend(ModelBackend):
     seqs_bucket: int = 8
     attn_impl: str = "auto"
     remat: bool = False
+    logprob_chunk: Optional[int] = 512
     train: bool = True
 
     def initialize(self, model: Model, spec: FinetuneSpec) -> Model:
@@ -834,6 +922,7 @@ class JaxTrainBackend(ModelBackend):
             seqs_bucket=self.seqs_bucket,
             attn_impl=self.attn_impl,
             remat=self.remat,
+            logprob_chunk=self.logprob_chunk,
         )
         model.module = engine
         return model

@@ -322,6 +322,7 @@ def forward(
     return_kv: bool = True,  # False in training: don't stack per-layer K/V
     return_aux: bool = False,  # also return MoE aux losses (layer means)
     pp_microbatches: Optional[int] = None,  # pipeline depth (None = auto)
+    return_hidden: bool = False,  # skip the head; return final hidden
 ):
     """Returns (output, kv) — or (output, kv, aux) when ``return_aux`` —
     where output is logits [B, T, V] (or values [B, T] for critics) and kv
@@ -408,17 +409,27 @@ def forward(
         )
     else:
         h = rms_norm(h, params["final_ln"], cfg.rms_norm_eps)
-    lg = "logits" if not decode else "logits_decode"
-    if cfg.is_critic:
-        out = (h @ params["value_head"])[..., 0]
-    elif cfg.tie_word_embeddings:
-        out = constrain(h @ params["embedding"].T, lg)
+    if return_hidden:
+        out = h  # caller applies the head (e.g. chunked-logprob loss)
     else:
-        out = constrain(h @ params["lm_head"], lg)
+        out = apply_head(
+            params, cfg, h, "logits" if not decode else "logits_decode"
+        )
     kv_out = {"k": ks, "v": vs} if ks is not None else None
     if return_aux:
         return out, kv_out, aux
     return out, kv_out
+
+
+def apply_head(params: Params, cfg: TransformerConfig, h, lg="logits"):
+    """Final-hidden → logits (or values). Shared by forward and the
+    engine's chunked-logprob path (backend/jax_train.py) so the head math
+    has exactly one definition."""
+    if cfg.is_critic:
+        return (h @ params["value_head"])[..., 0]
+    if cfg.tie_word_embeddings:
+        return constrain(h @ params["embedding"].T, lg)
+    return constrain(h @ params["lm_head"], lg)
 
 
 def init_kv_cache(

@@ -158,6 +158,13 @@ def pipeline_apply_layers(
         aux_out = {
             k: jax.lax.psum(v, "pp") for k, v in aux_acc.items()
         }
+        # KNOWN COST: ys stacks each stage's per-step outputs
+        # ([steps, mb, T, D] per device ≈ (1 + (pp-1)/n_micro)·[B, T, D])
+        # although only the last stage's n_micro blocks are consumed. A
+        # carry-buffer formulation (dynamic_update masked to the last
+        # stage) removes the overhead but currently trips partial-manual
+        # shard_map autodiff (mesh-consistency check in the transpose);
+        # revisit when jax's manual-axes vjp handles it.
         return ys, aux_out
 
     # Manual over "pp" ONLY: layer stacks arrive as local [L/pp, ...]

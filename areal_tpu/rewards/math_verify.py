@@ -208,6 +208,13 @@ def _symbolic_equal(a: str, b: str, timeout: float = 3.0) -> bool:
         return False
     import multiprocessing as mp
 
+    # Pre-import sympy in the PARENT: forked children inherit the loaded
+    # module. Without this every fork re-imports sympy from disk (~1s),
+    # eating the timeout and nondeterministically failing genuinely-equal
+    # symbolic answers on a loaded host.
+    import sympy  # noqa: F401
+    import sympy.parsing.sympy_parser  # noqa: F401
+
     ctx = mp.get_context("fork")
     q = ctx.Queue()
     proc = ctx.Process(target=_symbolic_child, args=(a, b, q), daemon=True)
@@ -244,14 +251,26 @@ def math_equal(pred: str, ref: str, rel_tol: float = 1e-4) -> bool:
     if vp is not None and vr is not None:
         return _numeric_equal(vp, vr, rel_tol)
 
-    # bracket-stripped comparison ("(1,2)" vs "[1,2]" vs "1,2")
-    if np_.strip("[]()") == nr.strip("[]()") and np_.strip("[]()"):
+    # Bracket-sensitive comparison. NOTE (deviation from the reference,
+    # which strips all brackets): "(0,1]" and "[0,1)" are DIFFERENT
+    # intervals — equal content with different bracket types must not
+    # grade 1.0, so stripping/element-wise paths require the SAME bracket
+    # characters at both ends.
+    both_bracketed = (
+        re.fullmatch(r"[\[(].+[\])]", np_) and re.fullmatch(r"[\[(].+[\])]", nr)
+    )
+    same_brackets = (
+        not both_bracketed or (np_[0] == nr[0] and np_[-1] == nr[-1])
+    )
+    if (
+        same_brackets
+        and np_.strip("[]()") == nr.strip("[]()")
+        and np_.strip("[]()")
+    ):
         return True
 
     # tuples / intervals / coordinate lists: element-wise, order-sensitive
-    if (
-        re.fullmatch(r"[\[(].+[\])]", np_) and re.fullmatch(r"[\[(].+[\])]", nr)
-    ):
+    if both_bracketed and same_brackets:
         pp, rr = _split_top_level(np_[1:-1]), _split_top_level(nr[1:-1])
         if len(pp) == len(rr) and len(pp) > 1:
             if all(math_equal(a, b, rel_tol) for a, b in zip(pp, rr)):

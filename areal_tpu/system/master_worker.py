@@ -204,16 +204,27 @@ class MasterWorker:
     # ---------------- per-step DFG traversal ----------------
 
     async def _load_data(self) -> None:
-        """Fetch a batch from the trainer's dataset/stream into the buffer."""
-        reply = await asyncio.to_thread(
-            self.stream.call, self.cfg.trainer_handler, "fetch",
-            self.cfg.train_batch_size,
-        )
-        meta: SequenceSample = reply["meta"]
-        self.epoch = reply["epoch"]
-        self._dataset_size = reply["dataset_size"]
-        singles = [meta.select_idx([i]) for i in range(meta.bs)]
-        await self.buffer.put_batch(singles)
+        """Fill one step's batch from the trainer's dataset/stream.
+
+        Stream mode may return PARTIAL (or empty) fetches — keep fetching
+        until train_batch_size samples landed in the buffer; a single
+        partial fetch treated as complete deadlocks every MFC gate
+        (n_seqs never satisfied) while the trainer sits idle."""
+        got = 0
+        while got < self.cfg.train_batch_size:
+            reply = await asyncio.to_thread(
+                self.stream.call, self.cfg.trainer_handler, "fetch",
+                self.cfg.train_batch_size - got,
+            )
+            self.epoch = reply["epoch"]
+            self._dataset_size = reply["dataset_size"]
+            meta: Optional[SequenceSample] = reply["meta"]
+            if meta is None or meta.bs == 0:
+                await asyncio.sleep(0.2)
+                continue
+            singles = [meta.select_idx([i]) for i in range(meta.bs)]
+            await self.buffer.put_batch(singles)
+            got += meta.bs
 
     def _hook_dicts(self, node: MFCDef, post: bool) -> List[Dict]:
         out = []

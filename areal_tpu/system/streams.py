@@ -125,11 +125,8 @@ class WorkerRequestServer:
         self._sock = self._ctx.socket(zmq.ROUTER)
         host = network.gethostip()
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
-        name_resolve.add(
-            req_reply_addr_key(experiment, trial, handler),
-            f"tcp://{host}:{port}",
-            replace=True,
-        )
+        self._key = req_reply_addr_key(experiment, trial, handler)
+        name_resolve.add(self._key, f"tcp://{host}:{port}", replace=True)
         self._peer_of: Dict[str, bytes] = {}
 
     def poll(self, timeout_ms: int = 0) -> Optional[Payload]:
@@ -145,6 +142,14 @@ class WorkerRequestServer:
         self._sock.send_multipart([ident, pickle.dumps(p)])
 
     def close(self):
+        # Withdraw the advertisement FIRST: a restarted experiment's
+        # master must not resolve this (about-to-die) address — connecting
+        # to a stale ROUTER port silently drops every request (the
+        # recover-test run-2 hang).
+        try:
+            name_resolve.delete(self._key)
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
         self._sock.close(linger=0)
 
 

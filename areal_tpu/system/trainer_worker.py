@@ -209,18 +209,26 @@ class TrainerWorker:
 
     # ---------------- handlers ----------------
 
-    def _read_batch(self, n: int) -> SequenceSample:
-        """Rank-0-only data-plane read (dataset or rollout stream)."""
+    def _read_batch(self, n: int) -> Optional[SequenceSample]:
+        """Rank-0-only data-plane read (dataset or rollout stream).
+
+        Stream mode returns WHATEVER is available within the wait window —
+        possibly fewer than ``n``, possibly None. The master accumulates
+        across fetches until its step batch is full (master_worker
+        _load_data); returning early keeps this serve loop responsive
+        instead of blocking an entire rollout round inside one request.
+        (A partial return that the master treated as complete was the
+        r2-era hang: buffer gates wait for n_seqs forever.)"""
         if self.cfg.stream_dataset:
             out: List[SequenceSample] = []
-            while len(out) < n:
+            deadline = time.monotonic() + 0.5
+            while len(out) < n and time.monotonic() < deadline:
                 try:
-                    out.append(self._pull_q.get(timeout=0.5))
+                    out.append(self._pull_q.get(timeout=0.1))
                 except queue.Empty:
                     if out:
-                        break  # partial batch is fine in async mode
-                    continue
-            return SequenceSample.gather(out)
+                        break
+            return SequenceSample.gather(out) if out else None
         idx = []
         while len(idx) < n and self._dataset is not None:
             if self._epoch_pos >= len(self._data_iter):
@@ -237,12 +245,13 @@ class TrainerWorker:
 
     def _handle_fetch(self, p: Payload) -> Any:
         batch = self._read_batch(int(p.data or self.cfg.batch_size))
-        # Every rank stores the same batch (multi-host: the jitted steps
-        # consume identical replicated host inputs on each process).
-        self._bcast(("fetch", batch))
-        self._store_batch(batch)
+        if batch is not None:
+            # Every rank stores the same batch (multi-host: the jitted
+            # steps consume identical replicated host inputs per process).
+            self._bcast(("fetch", batch))
+            self._store_batch(batch)
         return {
-            "meta": batch.meta(),
+            "meta": batch.meta() if batch is not None else None,
             "epoch": self._epoch,
             "epoch_pos": self._epoch_pos,
             "dataset_size": len(self._dataset) if self._dataset else -1,
@@ -277,7 +286,20 @@ class TrainerWorker:
         method = req.get("method", mc.method)
         for hook in p.pre_hooks:
             self._run_hook(hook)
-        out = getattr(iface, method)(model, batch, mb_spec)
+        trace_dir = os.environ.get("AREAL_DUMP_TRACE")
+        if trace_dir:
+            # Env-gated per-MFC profiler (reference REAL_DUMP_TRACE,
+            # model_worker.py:829 __maybe_profile_rpc): one jax.profiler
+            # trace per MFC invocation, viewable in tensorboard/xprof.
+            import jax
+
+            out_dir = os.path.join(
+                trace_dir, f"{mfc_name}_{model.version.global_step}"
+            )
+            with jax.profiler.trace(out_dir):
+                out = getattr(iface, method)(model, batch, mb_spec)
+        else:
+            out = getattr(iface, method)(model, batch, mb_spec)
         result: Dict[str, Any] = {"stats": None, "meta": None}
         if method == "train_step":
             result["stats"] = out

@@ -55,10 +55,8 @@ class WorkerControl:
         self._sock = self._ctx.socket(zmq.REP)
         host = network.gethostip()
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
-        name_resolve.add(
-            worker_control_key(experiment, trial, worker_name),
-            f"tcp://{host}:{port}", replace=True,
-        )
+        self._key = worker_control_key(experiment, trial, worker_name)
+        name_resolve.add(self._key, f"tcp://{host}:{port}", replace=True)
         self._reconfigure_cb: Optional[Callable[[Any], Any]] = None
         self._t_start = time.monotonic()
         self._iterations = 0
@@ -133,6 +131,12 @@ class WorkerControl:
                 return self.state
 
     def close(self) -> None:
+        # Withdraw the advertisement so a restarted experiment's panel
+        # never resolves this dead endpoint (stale-address hang).
+        try:
+            name_resolve.delete(self._key)
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
         self._sock.close(linger=0)
 
 
@@ -167,8 +171,20 @@ class WorkerControlPanel:
 
     def command(self, worker: str, cmd: str, **kw) -> Dict:
         s = self._sock_for(worker)
-        s.send(pickle.dumps({"cmd": cmd, **kw}))
-        return pickle.loads(s.recv())
+        try:
+            s.send(pickle.dumps({"cmd": cmd, **kw}))
+            return pickle.loads(s.recv())
+        except zmq.ZMQError as e:
+            # A timed-out REQ socket is stuck in its awaiting-reply state
+            # (every further send raises EFSM) — tear it down so the next
+            # command reconnects fresh. Workers serve control only between
+            # loop iterations, so timeouts during a long step are normal.
+            s.close(linger=0)
+            self._socks.pop(worker, None)
+            raise TimeoutError(
+                f"worker {worker!r} did not answer {cmd!r} within "
+                f"{self.timeout}s (busy in a step?): {e}"
+            ) from None
 
     def pause(self, worker: str) -> Dict:
         return self.command(worker, "pause")

@@ -53,6 +53,10 @@ def main():
                                   mu_dtype="bfloat16", nu_dtype="bfloat16"),
         compute_dtype="bfloat16", length_bucket=512, rows_bucket=4,
         seqs_bucket=16, remat=False,
+        # At the 2048-token cap the [2,1024,V] logits fit (0.6GB), so the
+        # chunked-logprob head's ~5% recompute buys nothing here; it stays
+        # on by default for inference paths and larger configs.
+        logprob_chunk=None,
     )
     model = backend.initialize(model, FinetuneSpec(1, 512, 64))
     # HONESTY NOTE vs BENCH_r04: r4's engine silently trained fully in

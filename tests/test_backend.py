@@ -75,6 +75,49 @@ def test_lr_schedule_shapes():
     assert float(sched(100)) == pytest.approx(1e-4, rel=1e-2)
 
 
+def test_chunked_logprob_head_parity():
+    """The chunked-logprob head (engine._forward_token_logprobs) must match
+    the full-logits path exactly — outputs AND gradients — for every chunk
+    size, including C == L (checkpoint-only) and C < L (lax.map)."""
+    cfg = tiny_config(vocab_size=64)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    R, L = 2, 32
+    batch = {
+        "tokens": jnp.asarray(rng.randint(0, 64, (R, L)), jnp.int32),
+        "positions": jnp.tile(jnp.arange(L, dtype=jnp.int32), (R, 1)),
+        "segment_ids": jnp.asarray(
+            np.where(np.arange(L) < 28, 1, 0)[None].repeat(R, 0), jnp.int32
+        ),
+    }
+    from areal_tpu.algorithms import ppo_functional as F
+
+    def full_lp(eng, p):
+        logits = eng._model_forward(p, batch)
+        return F.token_logprobs_from_logits(
+            logits, batch["tokens"], batch["segment_ids"]
+        )
+
+    ref_eng = JaxTrainEngine(cfg, params, compute_dtype="float32",
+                             logprob_chunk=None)
+    ref = full_lp(ref_eng, ref_eng.params)
+    g_ref = jax.grad(lambda p: jnp.sum(full_lp(ref_eng, p) ** 2))(
+        ref_eng.params
+    )
+    for chunk in (8, 16, 32, 64):
+        eng = JaxTrainEngine(cfg, params, compute_dtype="float32",
+                             logprob_chunk=chunk)
+        lp, aux = eng._forward_token_logprobs(eng.params, batch)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        g = jax.grad(
+            lambda p: jnp.sum(eng._forward_token_logprobs(p, batch)[0] ** 2)
+        )(eng.params)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+
 def test_scale_by_adam_mixed_matches_optax():
     """The mixed-dtype Adam (backend.scale_by_adam_mixed) with f32 moments
     must match optax.adamw exactly; bf16 moments track within bf16 noise."""

@@ -129,6 +129,10 @@ def test_math_equal_deep_semantics():
     assert me("(\\frac{1}{2}, 3)", "(0.5, 3)")
     assert not me("(1, 2)", "(2, 1)")
     assert me("[0, \\pi)", "[0,pi)")
+    # endpoint inclusion matters: same content, different bracket types
+    assert not me("(0,1]", "[0,1)")
+    assert not me("(0, 1)", "[0, 1]")
+    assert me("(0,1]", "(0, 1]")
     # matrices, element-wise
     assert me(
         "\\begin{pmatrix}1 & 2\\\\3 & 4\\end{pmatrix}",

@@ -81,7 +81,7 @@ stats = iface.train_step(model, batch, MicroBatchSpec())
 ck = os.path.join(nr_dir, "ck")
 model.module.save_train_state(ck)
 if rank == 0:
-    assert os.path.exists(os.path.join(ck, "params.npz"))
+    assert os.path.exists(os.path.join(ck, "params.safetensors"))
     print("RESULT " + json.dumps({"loss": stats["actor_loss"]}))
 """
 

@@ -69,6 +69,45 @@ def test_pause_resume_status_exit(tmp_name_resolve):
         panel.close()
 
 
+def test_panel_recovers_after_timeout(tmp_name_resolve):
+    """A command that times out (worker busy in a long step) must not
+    brick the panel's REQ socket — the next command reconnects."""
+    import pytest
+
+    counter = [0]
+    stop = threading.Event()
+    hold = threading.Event()
+
+    def slow_worker():
+        ctrl = WorkerControl(EXP, TRIAL, "slow")
+        ctrl.step()  # register + enter RUNNING
+        hold.wait(timeout=30)  # simulate a long step: control unserved
+        while not stop.is_set():
+            ctrl.step(lambda: {"count": counter[0]})
+            if ctrl.should_exit:
+                break
+            time.sleep(0.005)
+        ctrl.close()
+
+    t = threading.Thread(target=slow_worker, daemon=True)
+    t.start()
+    panel = WorkerControlPanel(EXP, TRIAL, timeout=0.5)
+    try:
+        with pytest.raises(TimeoutError):
+            panel.status("slow")  # worker is "busy"; 0.5s timeout fires
+        hold.set()  # step finishes; control served again
+        time.sleep(0.1)
+        st = panel.status("slow")  # fresh socket; must work
+        assert st["ok"] and st["state"] == "running"
+        panel.exit("slow")
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        stop.set()
+        hold.set()
+        panel.close()
+
+
 def test_consumed_log_roundtrip(tmp_path):
     """Async-recovery skiplist (rollout_worker.ConsumedLog): a restarted
     worker must skip uids consumed before the crash."""
