@@ -730,8 +730,6 @@ class JaxTrainEngine(TrainableEngine):
     # order) — the restoring engine always has the identical structure.
 
     def save_train_state(self, ckpt_dir: str) -> None:
-        import os
-
         from safetensors.numpy import save_file
 
         from areal_tpu.parallel import distributed as dist

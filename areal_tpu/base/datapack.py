@@ -79,6 +79,29 @@ def partition_contiguous_balanced(sizes: Sequence[int], k: int) -> List[List[int
     return [list(range(bounds[i], bounds[i + 1])) for i in range(k)]
 
 
+def _ffd_native(sizes: Sequence[int], capacity: int):
+    """Native first-fit-decreasing (csrc/interval_ops.cpp ffd_assign) —
+    bit-identical bin contents to the Python loop (same stable decreasing
+    order, same first-fit scan). None → caller runs the Python path."""
+    if len(sizes) < 64:  # ctypes call overhead beats tiny inputs
+        return None
+    try:
+        from areal_tpu.ops import native
+    except ImportError:
+        return None
+    bin_of = native.ffd_assign(sizes, capacity)
+    if bin_of is None:
+        return None
+    n_bins = int(bin_of.max()) + 1 if len(bin_of) else 0
+    bins: List[List[int]] = [[] for _ in range(n_bins)]
+    # Within-bin order must match the Python loop (items appended in
+    # decreasing-size order) — min_groups splitting pops the LAST item.
+    order = sorted(range(len(sizes)), key=lambda i: -int(sizes[i]))
+    for i in order:
+        bins[int(bin_of[i])].append(i)
+    return bins
+
+
 def ffd_allocate(
     sizes: Sequence[int], capacity: int, min_groups: int = 1
 ) -> List[List[int]]:
@@ -86,21 +109,26 @@ def ffd_allocate(
     total size is <= capacity (single items larger than capacity get their own
     group), producing at least ``min_groups`` groups when possible.
     """
-    order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
     bins: List[List[int]] = []
     loads: List[int] = []
-    for i in order:
-        s = int(sizes[i])
-        placed = False
-        for b in range(len(bins)):
-            if loads[b] + s <= capacity:
-                bins[b].append(i)
-                loads[b] += s
-                placed = True
-                break
-        if not placed:
-            bins.append([i])
-            loads.append(s)
+    native_bins = _ffd_native(sizes, capacity)
+    if native_bins is not None:
+        bins = native_bins
+        loads = [sum(int(sizes[i]) for i in b) for b in bins]
+    else:
+        order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
+        for i in order:
+            s = int(sizes[i])
+            placed = False
+            for b in range(len(bins)):
+                if loads[b] + s <= capacity:
+                    bins[b].append(i)
+                    loads[b] += s
+                    placed = True
+                    break
+            if not placed:
+                bins.append([i])
+                loads.append(s)
     while len(bins) < min_groups and any(len(b) > 1 for b in bins):
         # Split the heaviest bin among those that can be split.
         candidates = [j for j in range(len(bins)) if len(bins[j]) > 1]

@@ -72,6 +72,18 @@ def batch_from_packed(
 ) -> np.ndarray:
     B, L = layout.shape
     out = np.full((B, L) + packed.shape[1:], fill, dtype=packed.dtype)
+    # Native fast path (csrc/interval_ops.cpp): one C call instead of one
+    # Python slice assignment per sequence — this runs for every per-token
+    # key of every micro-batch of every train step.
+    if packed.ndim == 1 and packed.flags.c_contiguous:
+        from areal_tpu.ops import native
+
+        rows = [p[0] for p in layout.placements]
+        cols = [p[1] for p in layout.placements]
+        lens = list(layout.seqlens)
+        offs = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        if native.scatter_intervals(packed, out, rows, cols, lens, offs):
+            return out
     off = 0
     for (row, col), n in zip(layout.placements, layout.seqlens):
         out[row, col : col + n] = packed[off : off + n]

@@ -1,0 +1,163 @@
+"""ctypes loader for the native host ops (csrc/interval_ops.cpp).
+
+The extension is compiled ON DEMAND with the system g++ into a per-user
+cache dir (no pybind11 / setuptools dependency, per the environment) and
+keyed by source hash, so editing the .cpp rebuilds automatically. Every
+entry point has a pure-NumPy fallback — machines without a compiler just
+run the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("ops.native")
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc", "interval_ops.cpp",
+)
+_CACHE = os.path.expanduser(
+    os.environ.get("AREAL_NATIVE_CACHE", "~/.cache/areal_tpu/native")
+)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+        tag = hashlib.sha256(src).hexdigest()[:16]
+        out = os.path.join(_CACHE, f"interval_ops_{tag}.so")
+        if os.path.exists(out):
+            return out
+        os.makedirs(_CACHE, exist_ok=True)
+        tmp = out + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+               "-o", tmp]
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        # Source missing (packaged install without csrc/), unwritable
+        # cache dir, no compiler — all mean "use the NumPy fallback",
+        # never a crash in the packing hot path.
+        logger.info(f"native build unavailable ({e}); using numpy fallback")
+        return None
+    if r.returncode != 0:
+        logger.warning(f"native build failed:\n{r.stderr[-500:]}")
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            logger.warning(f"native lib load failed ({e}); numpy fallback")
+            return None
+        I64P = ctypes.POINTER(ctypes.c_int64)
+        U8P = ctypes.POINTER(ctypes.c_uint8)
+        for fn in (lib.scatter_intervals, lib.gather_intervals):
+            fn.argtypes = [U8P, U8P, I64P, I64P, I64P, I64P,
+                           ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+            fn.restype = None
+        lib.ffd_assign.argtypes = [I64P, I64P, ctypes.c_int64,
+                                   ctypes.c_int64, I64P, I64P, I64P]
+        lib.ffd_assign.restype = ctypes.c_int64
+        _lib = lib
+        logger.info(f"native interval ops loaded from {path}")
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64(arr) -> np.ndarray:
+    return np.ascontiguousarray(arr, dtype=np.int64)
+
+
+def _p(arr: np.ndarray, typ):
+    return arr.ctypes.data_as(typ)
+
+
+def scatter_intervals(
+    packed: np.ndarray,  # [total] contiguous (1-D per-token key)
+    out: np.ndarray,  # [R, L] contiguous, pre-filled
+    rows, cols, lens, offs,
+) -> bool:
+    """out[rows[i], cols[i]:cols[i]+lens[i]] = packed[offs[i]:...]; returns
+    False (caller must fall back) when the native lib is unavailable or
+    the arrays aren't the simple 1-D-key / 2-D-grid shape."""
+    lib = _load()
+    if lib is None or out.ndim != 2 or packed.ndim != 1:
+        return False
+    rows, cols, lens, offs = map(_i64, (rows, cols, lens, offs))
+    U8P = ctypes.POINTER(ctypes.c_uint8)
+    I64P = ctypes.POINTER(ctypes.c_int64)
+    lib.scatter_intervals(
+        _p(packed, U8P), _p(out, U8P),
+        _p(rows, I64P), _p(cols, I64P), _p(lens, I64P), _p(offs, I64P),
+        len(rows), out.shape[1], packed.dtype.itemsize,
+    )
+    return True
+
+
+def gather_intervals(
+    grid: np.ndarray,  # [R, L] contiguous
+    out: np.ndarray,  # [total] contiguous
+    rows, cols, lens, offs,
+) -> bool:
+    lib = _load()
+    if lib is None:
+        return False
+    rows, cols, lens, offs = map(_i64, (rows, cols, lens, offs))
+    U8P = ctypes.POINTER(ctypes.c_uint8)
+    I64P = ctypes.POINTER(ctypes.c_int64)
+    lib.gather_intervals(
+        _p(grid, U8P), _p(out, U8P),
+        _p(rows, I64P), _p(cols, I64P), _p(lens, I64P), _p(offs, I64P),
+        len(rows), grid.shape[1], grid.dtype.itemsize,
+    )
+    return True
+
+
+def ffd_assign(sizes, capacity: int) -> Optional[np.ndarray]:
+    """First-fit-decreasing bin ids per item (None → fall back)."""
+    lib = _load()
+    if lib is None:
+        return None
+    sizes = _i64(sizes)
+    n = len(sizes)
+    order = _i64(np.argsort(-sizes, kind="stable"))
+    bin_of = np.empty(n, np.int64)
+    loads = np.zeros(max(n, 1), np.int64)
+    n_bins = np.zeros(1, np.int64)
+    I64P = ctypes.POINTER(ctypes.c_int64)
+    lib.ffd_assign(
+        _p(sizes, I64P), _p(order, I64P), n, int(capacity),
+        _p(bin_of, I64P), _p(loads, I64P), _p(n_bins, I64P),
+    )
+    return bin_of
