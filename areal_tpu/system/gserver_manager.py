@@ -60,6 +60,7 @@ class GserverManager:
         self.running_rollouts = 0
         self.accepted_rollouts = 0  # trained samples submitted
         self._watcher_task = None
+        self._url: Optional[str] = None
         # Weight-sync latency bookkeeping (north-star metric #2).
         self.last_sync_fanout_secs: Optional[float] = None
         self.last_sync_e2e_secs: Optional[float] = None
@@ -199,6 +200,30 @@ class GserverManager:
             ],
         })
 
+    async def handle_metrics_discovery(self, request):
+        """Scrape-target discovery (reference controller.py:41-74 exposes
+        the same for its Prometheus scraper): every metrics endpoint of
+        this experiment — the generation servers' and this manager's —
+        in http_sd format ([{"targets": [...], "labels": {...}}])."""
+        from aiohttp import web
+
+        def _host(u: str) -> str:
+            return u.split("//", 1)[-1]
+
+        groups = [{
+            "targets": [_host(u) for u in self.servers],
+            "labels": {"experiment": self.cfg.experiment,
+                       "trial": self.cfg.trial, "role": "generation_server"},
+        }]
+        if self._url:
+            groups.append({
+                "targets": [_host(self._url)],
+                "labels": {"experiment": self.cfg.experiment,
+                           "trial": self.cfg.trial,
+                           "role": "gserver_manager"},
+            })
+        return web.json_response(groups)
+
     # ---------------- weight-update fanout ----------------
 
     async def _watch_weights(self):
@@ -277,6 +302,7 @@ class GserverManager:
         app.router.add_post("/finish_rollout", self.handle_finish_rollout)
         app.router.add_get("/get_model_version", self.handle_get_model_version)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/metrics_discovery", self.handle_metrics_discovery)
         return app
 
     async def start(self) -> str:
@@ -290,6 +316,7 @@ class GserverManager:
         site = web.TCPSite(runner, network.bind_addr(), port)
         await site.start()
         url = f"http://{network.gethostip()}:{port}"
+        self._url = url
         name_resolve.add(
             names.gen_server_manager(self.cfg.experiment, self.cfg.trial),
             url, replace=True,

@@ -111,6 +111,18 @@ def test_async_rollout_stack(env):
             await asyncio.sleep(0.1)
         assert server.version == 1 and mgr.version == 1
 
+        # ---- metric-target discovery (reference controller.py:41-74) ----
+        import aiohttp
+
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"{mgr._url}/metrics_discovery") as r:
+                groups = await r.json()
+        roles = {g["labels"]["role"]: g["targets"] for g in groups}
+        assert "generation_server" in roles and "gserver_manager" in roles
+        assert len(roles["generation_server"]) == 1
+        # targets are scrape-able host:port (no scheme)
+        assert all("//" not in t for g in groups for t in g["targets"])
+
         await mgr.stop()
         await server.stop()
         puller.close()
