@@ -130,7 +130,8 @@ class AutomaticEvaluator:
                     for k, v in (step.scores or {}).items()
                     if isinstance(v, (int, float))
                 }
-                self.writer.log(metrics, step=step.step)
+                # MetricWriter API (base/monitor.py:115): write(stats, step)
+                self.writer.write(metrics, step.step)
             return True
         except Exception as e:  # noqa: BLE001 — eval must not kill training
             step.status = "failed"
@@ -178,3 +179,5 @@ class AutomaticEvaluator:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self.writer is not None and hasattr(self.writer, "close"):
+            self.writer.close()

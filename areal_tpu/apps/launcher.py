@@ -365,10 +365,21 @@ class LocalLauncher:
                     eval_data, exp.dataset.path,
                 )
                 eval_data = exp.dataset.path
+            # eval/* metrics land in the run's tensorboard alongside the
+            # master's training scalars (separate writer, same log dir).
+            eval_writer = None
+            tb = getattr(setup["master"], "tensorboard_path", None)
+            if tb:
+                from areal_tpu.base.monitor import MetricWriter
+
+                eval_writer = MetricWriter(
+                    tensorboard_path=os.path.join(tb, "eval")
+                )
             evaluator = AutomaticEvaluator(
                 exp.auto_eval_config,
                 save_dir=setup["master"].save_dir,
                 dataset_path=eval_data,
+                metric_writer=eval_writer,
                 mock_tokenizer=bool(getattr(exp, "mock_tokenizer", False)),
             )
             evaluator.start()

@@ -45,9 +45,13 @@ def test_evaluator_runs_injected_eval_and_logs(tmp_path):
     ran = []
 
     class Writer:
+        """Mirrors MetricWriter's API (base/monitor.py:115) — the
+        evaluator must call write(stats, step), not a log() that only a
+        fake would have."""
+
         logged = []
 
-        def log(self, metrics, step):
+        def write(self, metrics, step):
             self.logged.append((step, metrics))
 
     def run_eval(step):
