@@ -79,15 +79,49 @@ def parse_ckpt_dirname(name: str) -> Optional[StepInfo]:
     return StepInfo(int(m.group(1)), int(m.group(2)), int(m.group(3)))
 
 
+# Terminal sentinel written into a checkpoint dir AFTER every file landed.
+# A crash mid-save leaves a dir without it; discovery skips such dirs so a
+# recovered run never restores from a half-written checkpoint.
+CKPT_COMPLETE_MARKER = ".complete"
+
+
+def mark_ckpt_complete(ckpt_dir: str) -> None:
+    tmp = os.path.join(ckpt_dir, CKPT_COMPLETE_MARKER + f".tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write("ok\n")
+    os.replace(tmp, os.path.join(ckpt_dir, CKPT_COMPLETE_MARKER))
+
+
+def ckpt_is_complete(ckpt_dir: str) -> bool:
+    if os.path.exists(os.path.join(ckpt_dir, CKPT_COMPLETE_MARKER)):
+        return True
+    # Pre-sentinel compat: those checkpoints end with trainer_state.json
+    # (the trainer writes it after every role's train state). It must
+    # PARSE — a torn write from a crash mid-dump is exactly the
+    # half-written state the sentinel exists to reject.
+    try:
+        with open(os.path.join(ckpt_dir, "trainer_state.json")) as f:
+            json.load(f)
+        return True
+    except Exception:  # noqa: BLE001 — missing or torn: incomplete
+        return False
+
+
 def discover_ckpt(save_root: str) -> Optional[str]:
-    """Latest checkpoint directory (by global step) under save_root."""
+    """Latest COMPLETE checkpoint directory (by global step) under
+    save_root; dirs missing the terminal sentinel (crash mid-save) are
+    skipped."""
     if not os.path.isdir(save_root):
         return None
     best: Optional[str] = None
     best_step = -1
     for name in os.listdir(save_root):
         info = parse_ckpt_dirname(name)
-        if info is not None and info.global_step > best_step:
-            best_step = info.global_step
-            best = os.path.join(save_root, name)
+        if info is None or info.global_step <= best_step:
+            continue
+        path = os.path.join(save_root, name)
+        if not ckpt_is_complete(path):
+            continue
+        best_step = info.global_step
+        best = path
     return best

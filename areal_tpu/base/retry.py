@@ -1,0 +1,122 @@
+"""Shared retry/backoff policy + fault injection for fleet robustness.
+
+One policy object serves every network hop in the system — the partial
+rollout client's chunk failover, the gserver manager's weight fanout, and
+the reward client's sandbox calls — so operators tune a single vocabulary
+of knobs (attempts, base/max delay, multiplier) instead of per-callsite
+magic numbers.
+
+``FaultInjector`` is the test seam: production code calls
+``maybe_fail("point")`` at failure-prone boundaries (chunk POST, schedule,
+fanout) and tests arm deterministic failures there, so chaos tests run in
+milliseconds instead of waiting on real sockets and TTLs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+from typing import Awaitable, Callable, Dict, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff: delay(n) = min(base * mult^(n-1), max)."""
+
+    max_attempts: int = 4
+    base_delay_secs: float = 0.1
+    max_delay_secs: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.0  # +/- fraction of the delay, de-synchronizes herds
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based failure count)."""
+        d = self.base_delay_secs * self.multiplier ** max(attempt - 1, 0)
+        d = min(d, self.max_delay_secs)
+        if self.jitter:
+            d *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(d, 0.0)
+
+
+async def aretry(
+    fn: Callable[[], Awaitable],
+    policy: RetryPolicy,
+    *,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    timeout: Optional[float] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Run ``fn`` up to ``policy.max_attempts`` times with backoff between
+    failures. ``timeout`` bounds EACH attempt (asyncio.wait_for), so the
+    worst case is max_attempts * (timeout + delay) — a budget the caller can
+    compute. The last failure is re-raised unchanged."""
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fn(), timeout)
+            return await fn()
+        except retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            await asyncio.sleep(policy.delay(attempt))
+
+
+# Fleet-wide default for generation chunk failover — referenced by both
+# PartialRolloutClient and RolloutWorkerConfig so the two cannot drift.
+DEFAULT_GENERATION_RETRY = RetryPolicy(
+    max_attempts=6, base_delay_secs=0.05, max_delay_secs=2.0
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by FaultInjector at an armed fault point."""
+
+
+class FaultInjector:
+    """Deterministic failure injection for chaos tests.
+
+    Production code threads an (optional) injector through and calls
+    ``maybe_fail(point, **ctx)`` at each failure boundary; with no injector
+    armed this is a dict lookup — effectively free. Tests arm points::
+
+        inj = FaultInjector()
+        inj.arm("generate", times=2)            # next 2 calls raise
+        inj.arm("fanout", times=-1,             # every call, selectively
+                when=lambda ctx: "dead" in ctx.get("url", ""))
+
+    ``times=-1`` means unlimited until :meth:`disarm`. ``fired`` counts
+    triggers per point so tests can assert the failure path actually ran.
+    """
+
+    def __init__(self):
+        self._armed: Dict[str, dict] = {}
+        self.fired: Dict[str, int] = {}
+
+    def arm(
+        self,
+        point: str,
+        times: int = 1,
+        exc: Optional[Callable[[], BaseException]] = None,
+        when: Optional[Callable[[dict], bool]] = None,
+    ) -> None:
+        self._armed[point] = {"times": times, "exc": exc, "when": when}
+
+    def disarm(self, point: str) -> None:
+        self._armed.pop(point, None)
+
+    def maybe_fail(self, point: str, **ctx) -> None:
+        spec = self._armed.get(point)
+        if spec is None or spec["times"] == 0:
+            return
+        if spec["when"] is not None and not spec["when"](ctx):
+            return
+        if spec["times"] > 0:
+            spec["times"] -= 1
+        self.fired[point] = self.fired.get(point, 0) + 1
+        exc = spec["exc"]
+        raise exc() if exc is not None else FaultInjected(point)

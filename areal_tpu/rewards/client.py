@@ -16,12 +16,38 @@ import json
 import os
 from typing import Any, Dict, List
 
+import dataclasses
+
 from areal_tpu.base import logging
+from areal_tpu.base.retry import RetryPolicy, aretry
 from areal_tpu.rewards import code_verify, math_verify
 
 logger = logging.getLogger("rewards.client")
 
 SERVICE_ENV = "FUNCTIONCALL_SERVICE_DOMAIN"
+
+# Shared fleet-wide backoff vocabulary (base/retry.py): sandbox calls retry
+# on the same capped-exponential schedule as generation failover.
+_REMOTE_RETRY = RetryPolicy(base_delay_secs=0.5, max_delay_secs=5.0)
+
+
+def _run_coro_blocking(coro):
+    """Run a coroutine to completion from ANY calling context. Plain
+    ``asyncio.run`` raises RuntimeError when the caller's thread already
+    hosts a running event loop (the async rollout path calls reward grading
+    from agent callbacks) — in that case run it on a dedicated thread with
+    its own loop instead."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    logger.warning(
+        "batch_reward called on a running event loop; grading on a "
+        "dedicated thread BLOCKS this loop until the batch completes — "
+        "prefer asyncio.to_thread(batch_reward, ...) from async code"
+    )
+    with cf.ThreadPoolExecutor(max_workers=1) as pool:
+        return pool.submit(asyncio.run, coro).result()
 
 
 def _grade_local(task: Dict[str, Any]) -> float:
@@ -64,23 +90,26 @@ def _batch_remote(tasks, domain: str, max_retries: int) -> List[float]:
         logger.warning(f"{SERVICE_ENV} set but aiohttp unavailable; local grading")
         return [_grade_local(t) for t in tasks]
 
+    policy = dataclasses.replace(_REMOTE_RETRY, max_attempts=max_retries + 1)
+
     async def call_one(session, task, sem):
         url = f"http://{domain}/{'math_verify' if task.get('task','math') in ('math','stem') else 'code_verify'}"
+
+        async def post_once():
+            async with session.post(url, json=task, timeout=aiohttp.ClientTimeout(total=120)) as r:
+                body = await r.text()
+                return float(json.loads(body).get("score", 0.0))
+
         async with sem:
-            for attempt in range(max_retries + 1):
-                try:
-                    async with session.post(url, json=task, timeout=aiohttp.ClientTimeout(total=120)) as r:
-                        body = await r.text()
-                        return float(json.loads(body).get("score", 0.0))
-                except Exception as e:  # noqa: BLE001 — retry then fall back
-                    if attempt == max_retries:
-                        logger.warning(f"remote reward failed ({e}); local fallback")
-                        return _grade_local(task)
-                    await asyncio.sleep(0.5 * (attempt + 1))
+            try:
+                return await aretry(post_once, policy)
+            except Exception as e:  # noqa: BLE001 — retries exhausted
+                logger.warning(f"remote reward failed ({e}); local fallback")
+                return _grade_local(task)
 
     async def run():
         sem = asyncio.Semaphore(64)
         async with aiohttp.ClientSession() as session:
             return await asyncio.gather(*[call_one(session, t, sem) for t in tasks])
 
-    return list(asyncio.run(run()))
+    return list(_run_coro_blocking(run()))

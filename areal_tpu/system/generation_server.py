@@ -262,6 +262,16 @@ class GenerationServer:
                 results = await asyncio.to_thread(self._decode_batch, batch)
                 for p, r in zip(batch, results):
                     p.future.set_result(r)
+            except asyncio.CancelledError:
+                # Server stopping mid-decode: fail the batch so its HTTP
+                # handlers return immediately instead of hanging through
+                # the runner's graceful-shutdown window.
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            RuntimeError("generation server stopping")
+                        )
+                raise
             except Exception as e:  # noqa: BLE001 — propagate per-request
                 for p in batch:
                     if not p.future.done():
@@ -324,9 +334,17 @@ class GenerationServer:
                                   "latency_s": dt})
 
     async def handle_health(self, request):
+        # Polled by the gserver manager's fleet-health loop: ``version`` is
+        # what the manager reconciles against when re-admitting this server
+        # after an eviction (docs/fault_tolerance.md).
         from aiohttp import web
 
-        return web.json_response({"ok": True, "version": self.version})
+        return web.json_response({
+            "ok": True,
+            "version": self.version,
+            "server_id": self.cfg.server_id,
+            "uptime_secs": time.monotonic() - self._t_start,
+        })
 
     async def handle_metrics(self, request):
         from aiohttp import web
@@ -374,7 +392,15 @@ class GenerationServer:
         self._runner_obj = runner
         return url
 
-    async def stop(self):
+    async def stop(self, abort: bool = False):
+        """Stop serving. ``abort=True`` is the crash-like path (chaos
+        tests): queued requests are failed immediately instead of drained,
+        so connected clients see errors now rather than a hung socket."""
         if self._runner_task:
             self._runner_task.cancel()
+        if abort and self._queue is not None:
+            while not self._queue.empty():
+                p = self._queue.get_nowait()
+                if not p.future.done():
+                    p.future.set_exception(RuntimeError("server aborted"))
         await self._runner_obj.cleanup()

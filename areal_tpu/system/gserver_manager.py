@@ -1,4 +1,5 @@
-"""Generation-server manager — routing, staleness gate, weight fanout.
+"""Generation-server manager — routing, staleness gate, weight fanout,
+fleet health.
 
 Parity target: ``realhf/system/gserver_manager.py:32`` — the singleton
 rollout controller: HTTP router over the generation-server fleet
@@ -10,6 +11,14 @@ and the weight-update fanout (watch ``names.model_version``, POST
 Staleness rule (reference ``is_staled`` :351):
     expected_version = (trained_samples + running) // train_batch_size
     allowed  iff  expected_version <= max_head_offpolicyness + current_version
+
+Fleet health (docs/fault_tolerance.md): a background loop polls every
+known server's ``GET /health``; ``health_failure_threshold`` consecutive
+failures evict a server from routing (its leases drain, its inflight slots
+free), a passing check re-admits it after its weights are reconciled to the
+current version, and newly registered servers join through the same gate.
+The weight fanout has a per-server timeout + bounded retry; a server that
+never acks is evicted rather than left silently serving stale weights.
 """
 
 from __future__ import annotations
@@ -22,6 +31,7 @@ import time
 from typing import Dict, List, Optional
 
 from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base.retry import FaultInjector, RetryPolicy, aretry
 
 logger = logging.getLogger("system.gserver_mgr")
 
@@ -43,12 +53,38 @@ class GserverManagerConfig:
     # Routing leases expire if the client neither renews (per chunk) nor
     # releases — a crashed client must not pin inflight counts forever.
     lease_ttl_secs: float = 120.0
+    # ---- fleet health / failure recovery (docs/fault_tolerance.md) ----
+    health_check_interval_secs: float = 2.0
+    health_check_timeout_secs: float = 2.0
+    # Consecutive /health failures before a server is evicted from routing.
+    health_failure_threshold: int = 3
+    # Per-server /update_weights budget: each attempt is bounded by
+    # fanout_timeout_secs and retried per fanout_retry before eviction.
+    fanout_timeout_secs: float = 60.0
+    fanout_retry: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(
+            max_attempts=2, base_delay_secs=0.2, max_delay_secs=2.0
+        )
+    )
+
+
+@dataclasses.dataclass
+class _ServerHealth:
+    """Per-server fleet-membership state (keyed by url)."""
+
+    routable: bool = True  # in the routing set
+    consecutive_failures: int = 0
+    acked_version: int = 0  # last weight version this server confirmed
+    evicted_reason: str = ""
+    reconciling: bool = False  # re-admission weight push in flight
 
 
 class GserverManager:
-    def __init__(self, cfg: GserverManagerConfig):
+    def __init__(self, cfg: GserverManagerConfig,
+                 fault_injector: Optional[FaultInjector] = None):
         self.cfg = cfg
-        self.servers: List[str] = []
+        self.servers: List[str] = []  # healthy, routable urls
+        self.health: Dict[str, _ServerHealth] = {}  # every known url
         self.version = 0
         self._rr = 0
         self._inflight: Dict[str, int] = {}  # url -> outstanding requests
@@ -60,7 +96,10 @@ class GserverManager:
         self.running_rollouts = 0
         self.accepted_rollouts = 0  # trained samples submitted
         self._watcher_task = None
+        self._health_task = None
+        self._reconcile_tasks: set = set()
         self._url: Optional[str] = None
+        self.faults = fault_injector
         # Weight-sync latency bookkeeping (north-star metric #2).
         self.last_sync_fanout_secs: Optional[float] = None
         self.last_sync_e2e_secs: Optional[float] = None
@@ -76,10 +115,188 @@ class GserverManager:
             if len(urls) >= self.cfg.n_servers:
                 self.servers = urls
                 self._inflight = {u: 0 for u in urls}
+                self.health = {u: _ServerHealth() for u in urls}
                 logger.info(f"found {len(urls)} generation servers")
                 return
             await asyncio.sleep(0.2)
         raise TimeoutError("generation servers did not register")
+
+    # ---------------- fleet health ----------------
+
+    def _evict(self, url: str, reason: str) -> None:
+        """Remove a server from routing: drain its leases, free its
+        inflight slots. The url stays in ``self.health`` so the health loop
+        keeps probing it for re-admission."""
+        st = self.health.setdefault(url, _ServerHealth())
+        if not st.routable and url not in self.servers:
+            return
+        st.routable = False
+        st.evicted_reason = reason
+        if url in self.servers:
+            self.servers.remove(url)
+        self._inflight.pop(url, None)
+        dropped = [lid for lid, (u, _) in self._leases.items() if u == url]
+        for lid in dropped:
+            del self._leases[lid]
+        logger.warning(
+            f"evicted {url} ({reason}); dropped {len(dropped)} leases, "
+            f"{len(self.servers)} servers remain"
+        )
+
+    def _admit(self, url: str) -> None:
+        st = self.health.get(url)
+        if st is None:
+            # Deregistered while a reconcile was in flight: stay forgotten
+            # rather than resurrecting a permanently-dead url into routing.
+            return
+        st.routable = True
+        st.consecutive_failures = 0
+        st.evicted_reason = ""
+        if url not in self.servers:
+            self.servers.append(url)
+            self.servers.sort()
+        self._inflight.setdefault(url, 0)
+
+    def _current_weight_path(self) -> str:
+        return os.path.join(
+            self.cfg.realloc_dir, self.cfg.model_role, str(self.version)
+        )
+
+    async def _reconcile_weights(self, sess, url: str,
+                                 server_version: int) -> bool:
+        """Bring a (re)joining server to the current weight version before
+        it serves traffic — a stale server would tag rollouts with old
+        version numbers AND old logprobs (silently off-policy)."""
+        if self.version == 0 or server_version >= self.version:
+            st = self.health.get(url)
+            if st is not None:  # entry may have been pruned mid-reconcile
+                st.acked_version = self.version
+            return True
+        ok = await self._push_weights_one(
+            sess, url, self.version, self._current_weight_path()
+        )
+        if not ok:
+            logger.warning(f"{url} failed weight reconcile to "
+                           f"v{self.version}; not re-admitting yet")
+        return ok
+
+    async def _check_one(self, sess, url: str) -> None:
+        import aiohttp
+
+        st = self.health.setdefault(url, _ServerHealth())
+        # Compare the probed version against the fleet version AT PROBE
+        # TIME: a fanout completing while the GET is in flight would
+        # otherwise make a just-updated server's (older) snapshot look
+        # stale and falsely evict it on every weight update.
+        version_at_probe = self.version
+        try:
+            if self.faults is not None:
+                self.faults.maybe_fail("health", url=url)
+            async with sess.get(
+                f"{url}/health",
+                timeout=aiohttp.ClientTimeout(
+                    total=self.cfg.health_check_timeout_secs
+                ),
+            ) as r:
+                if r.status != 200:
+                    raise RuntimeError(f"/health status {r.status}")
+                body = await r.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any probe failure counts
+            st.consecutive_failures += 1
+            if (
+                st.routable
+                and st.consecutive_failures
+                >= self.cfg.health_failure_threshold
+            ):
+                self._evict(url, f"{st.consecutive_failures} consecutive "
+                                 f"health failures ({e})")
+            return
+        st.consecutive_failures = 0
+        if st.routable and int(body.get("version", 0)) < version_at_probe:
+            # A routable server reporting an old version was restarted in
+            # place (pinned port: same url, fresh process at base weights).
+            # Demote it — the reconcile path below brings it back at the
+            # current version instead of letting it serve stale weights.
+            self._evict(
+                url, f"reports v{body.get('version')} < fleet "
+                     f"v{version_at_probe} (in-place restart?)"
+            )
+        if not st.routable and not st.reconciling:
+            # Re-admission reconcile runs DETACHED: a slow weight load on
+            # one rejoining server must not stall the sweep (and eviction
+            # of other dead servers) for the whole fanout budget.
+            st.reconciling = True
+            server_version = int(body.get("version", 0))
+
+            async def _readmit():
+                try:
+                    if not await self._reconcile_weights(
+                        sess, url, server_version
+                    ):
+                        return
+                    cur = self.health.get(url)
+                    if cur is None:
+                        return  # deregistered mid-reconcile: stay forgotten
+                    if cur.acked_version < self.version:
+                        # A fanout advanced the fleet past the version we
+                        # just reconciled to — admitting now would route to
+                        # stale weights; the next sweep reconciles again.
+                        return
+                    self._admit(url)
+                    logger.info(
+                        f"re-admitted {url} at weight v{self.version}"
+                    )
+                finally:
+                    st.reconciling = False
+
+            t = asyncio.ensure_future(_readmit())
+            self._reconcile_tasks.add(t)
+            t.add_done_callback(self._reconcile_tasks.discard)
+
+    async def check_fleet(self, sess) -> None:
+        """One health sweep: pick up new registrations from name_resolve,
+        drop deregistered urls, probe every known server, evict/re-admit
+        accordingly."""
+        root = names.gen_server_root(self.cfg.experiment, self.cfg.trial)
+        try:
+            registered = set(name_resolve.get_subtree(root))
+        except Exception:  # noqa: BLE001 — name-resolve hiccups are benign
+            registered = None
+        if registered is not None:
+            for url in registered:
+                if url not in self.health:
+                    # New registration joins through the health gate —
+                    # routed only after a passing probe + weight reconcile.
+                    self.health[url] = _ServerHealth(routable=False)
+                    logger.info(f"discovered new server {url}")
+            for url in list(self.health):
+                if url not in registered:
+                    # Deregistered: a restarted server binds a fresh port,
+                    # so the old url never comes back — forget it instead
+                    # of probing it (and growing /metrics) forever.
+                    self._evict(url, "deregistered from name_resolve")
+                    del self.health[url]
+        await asyncio.gather(*[
+            self._check_one(sess, u) for u in list(self.health)
+        ])
+
+    async def _health_loop(self):
+        import aiohttp
+
+        # No session-level timeout: /health probes carry their own
+        # per-request budget, while re-admission weight reconciles are
+        # bounded by the (much larger) fanout timeout in aretry.
+        async with aiohttp.ClientSession() as sess:
+            while True:
+                try:
+                    await self.check_fleet(sess)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — loop must survive
+                    logger.warning(f"health sweep error: {e}")
+                await asyncio.sleep(self.cfg.health_check_interval_secs)
 
     # ---------------- scheduling ----------------
 
@@ -92,10 +309,12 @@ class GserverManager:
                 self._inflight[url] -= 1
             logger.warning(f"lease {lid} on {url} expired (client gone?)")
 
-    def _pick_server(self) -> str:
+    def _pick_server(self) -> Optional[str]:
         self._expire_leases()
+        if not self.servers:
+            return None
         if self.cfg.schedule_policy == "least_requests":
-            return min(self.servers, key=lambda u: self._inflight[u])
+            return min(self.servers, key=lambda u: self._inflight.get(u, 0))
         url = self.servers[self._rr % len(self.servers)]
         self._rr += 1
         return url
@@ -112,6 +331,12 @@ class GserverManager:
         from aiohttp import web
 
         url = self._pick_server()
+        if url is None:
+            # Whole fleet evicted/dead: clients back off and retry — the
+            # health loop re-admits servers as they recover.
+            return web.json_response(
+                {"url": None, "reason": "no_healthy_servers"}, status=503
+            )
         self._inflight[url] += 1
         self._lease_seq += 1
         lease_id = f"l{self._lease_seq}"
@@ -146,8 +371,16 @@ class GserverManager:
                 if self._inflight.get(u, 0) > 0:
                     self._inflight[u] -= 1
             return web.json_response({"ok": True})
-        # legacy: release by url (no lease bookkeeping)
+        # Legacy: release by url. Must ALSO retire the lease pointing at
+        # that url — otherwise the orphaned lease's TTL expiry later
+        # decrements the same inflight slot a second time. Without a client
+        # identity on leases the match is only safe when UNAMBIGUOUS
+        # (exactly one lease on the url); with concurrent leases we must
+        # not guess and delete another client's lease.
         u = d.get("url")
+        matches = [lid for lid, (lu, _) in self._leases.items() if lu == u]
+        if len(matches) == 1:
+            del self._leases[matches[0]]
         if u in self._inflight and self._inflight[u] > 0:
             self._inflight[u] -= 1
         return web.json_response({"ok": True})
@@ -192,6 +425,17 @@ class GserverManager:
             "version": self.version,
             "running_rollouts": self.running_rollouts,
             "accepted_rollouts": self.accepted_rollouts,
+            "healthy_servers": len(self.servers),
+            "known_servers": len(self.health),
+            "fleet": {
+                u: {
+                    "routable": st.routable,
+                    "consecutive_failures": st.consecutive_failures,
+                    "acked_version": st.acked_version,
+                    "evicted_reason": st.evicted_reason,
+                }
+                for u, st in self.health.items()
+            },
             "weight_sync_fanout_secs": self.last_sync_fanout_secs,
             "weight_sync_e2e_secs": self.last_sync_e2e_secs,
             "weight_sync_history": [
@@ -226,6 +470,77 @@ class GserverManager:
 
     # ---------------- weight-update fanout ----------------
 
+    async def _push_weights_one(self, sess, url: str, v: int,
+                                path: str) -> bool:
+        """POST /update_weights to one server, bounded by the per-server
+        timeout and retried per ``fanout_retry``. Returns ack success."""
+
+        async def _post():
+            if self.faults is not None:
+                self.faults.maybe_fail("fanout", url=url, version=v)
+            async with sess.post(
+                f"{url}/update_weights", json={"path": path, "version": v}
+            ) as r:
+                if r.status != 200:
+                    raise RuntimeError(f"/update_weights status {r.status}")
+                await r.read()
+            return True
+
+        try:
+            await aretry(
+                _post, self.cfg.fanout_retry,
+                timeout=self.cfg.fanout_timeout_secs,
+                on_retry=lambda n, e: logger.warning(
+                    f"weight push v{v} -> {url} attempt {n} failed: {e}"
+                ),
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — ack failure, not fatal
+            logger.warning(f"weight push v{v} -> {url} gave up: {e}")
+            return False
+        st = self.health.get(url)
+        if st is not None:  # entry may have been pruned mid-push
+            st.acked_version = v
+        return True
+
+    async def fanout_weights(self, sess, v: int, path: str) -> List[str]:
+        """Push version ``v`` to every routable server concurrently. Bumps
+        ``self.version`` only when at least one server acked; a server that
+        exhausts its retry budget is EVICTED (never silently left serving
+        stale weights behind a bumped version). Returns the acked urls."""
+        targets = list(self.servers)
+        results = await asyncio.gather(*[
+            self._push_weights_one(sess, u, v, path) for u in targets
+        ])
+        acked = [u for u, ok in zip(targets, results) if ok]
+        if not acked:
+            # SYSTEMIC failure (bad/late weight path, shared-FS lag): no
+            # server acked, so the fault is almost certainly not per-server.
+            # Evicting the whole fleet here would drop every lease and flap
+            # (health re-admits, next poll evicts again) — hold the version
+            # and let the watcher retry; genuinely dead servers are the
+            # health loop's job.
+            logger.error(f"weight v{v}: no server acked; version held at "
+                         f"{self.version} for retry next poll")
+            return []
+        for u, ok in zip(targets, results):
+            if not ok:
+                self._evict(u, f"no ack for weight v{v}")
+        self.version = v
+        # Close the re-admission race: a server admitted WHILE this fanout
+        # was in flight reconciled against the old version and is not in
+        # ``targets`` — demote it so the health loop reconciles it to v
+        # before it routes again (never stale).
+        for u in list(self.servers):
+            st = self.health.get(u)
+            if u not in targets and st and st.acked_version < v:
+                self._evict(
+                    u, f"admitted mid-fanout at stale "
+                       f"v{st.acked_version} (< v{v})"
+                )
+        return acked
+
     async def _watch_weights(self):
         import aiohttp
 
@@ -237,18 +552,16 @@ class GserverManager:
                 v = int(name_resolve.get(key))
             except Exception:  # noqa: BLE001 — key not yet published
                 v = self.version
-            if v > self.version:
+            if v > self.version and self.servers:
                 path = os.path.join(
                     self.cfg.realloc_dir, self.cfg.model_role, str(v)
                 )
                 t0 = time.monotonic()
                 async with aiohttp.ClientSession() as sess:
-                    await asyncio.gather(*[
-                        sess.post(f"{u}/update_weights",
-                                  json={"path": path, "version": v})
-                        for u in self.servers
-                    ])
-                self.version = v
+                    acked = await self.fanout_weights(sess, v, path)
+                if not acked:
+                    await asyncio.sleep(self.cfg.weight_poll_secs)
+                    continue
                 fanout_secs = time.monotonic() - t0
                 # End-to-end weight-sync latency (north-star metric #2,
                 # BASELINE.json): trainer save START → every server swapped.
@@ -310,6 +623,7 @@ class GserverManager:
 
         await self.wait_for_servers()
         self._watcher_task = asyncio.create_task(self._watch_weights())
+        self._health_task = asyncio.create_task(self._health_loop())
         runner = web.AppRunner(self.build_app())
         await runner.setup()
         port = self.cfg.port or network.find_free_port()
@@ -326,6 +640,14 @@ class GserverManager:
         return url
 
     async def stop(self):
-        if self._watcher_task:
-            self._watcher_task.cancel()
+        tasks = [t for t in
+                 [self._watcher_task, self._health_task,
+                  *self._reconcile_tasks] if t]
+        for t in tasks:
+            t.cancel()
+        # Let cancellations unwind before tearing down the HTTP runner —
+        # otherwise a mid-POST reconcile races the session close and logs
+        # destroyed-pending-task noise.
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         await self._runner_obj.cleanup()

@@ -154,6 +154,9 @@ class MasterWorker:
         name = recover.ckpt_dirname(self.epoch, self.step, self.step)
         ckpt_dir = f"{self.cfg.recover_dir}/{name}"
         self.stream.call(self.cfg.trainer_handler, "ckpt", {"dir": ckpt_dir})
+        # Terminal sentinel AFTER the trainer acked the save: a crash
+        # mid-save leaves the dir incomplete and discover_ckpt skips it.
+        recover.mark_ckpt_complete(ckpt_dir)
         si = recover.StepInfo(self.epoch, self.step, self.step)
         recover.dump(self.cfg.recover_dir, recover.RecoverInfo(
             recover_start=si, last_step_info=si,

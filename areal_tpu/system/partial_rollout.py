@@ -6,10 +6,20 @@ updates only ever interrupt a chunk; carry accumulated tokens + logprobs
 across chunks; sticky-route to the same server while the version is
 unchanged; group ``group_size`` samples per prompt into one bundle with
 ``version_start``/``version_end`` per sample (the decoupled-loss inputs).
+
+Failure recovery (docs/fault_tolerance.md): because every ``/generate``
+call carries the full accumulated prefix, a dead server costs at most one
+chunk — the client releases the dead route, re-``/schedule_request``s onto
+a healthy server with capped exponential backoff, and the replacement
+server re-prefills ``prompt + accumulated tokens`` and continues. After
+``retry.max_attempts`` CONSECUTIVE failures the generation is abandoned
+with :class:`GenerationAbandonedError`, which the rollout worker converts
+into a clean ``/finish_rollout`` (quota never leaks, worker never dies).
 """
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import uuid
 from typing import Dict, List, Optional
@@ -18,8 +28,24 @@ import numpy as np
 
 from areal_tpu.api.model import GenerationHyperparameters
 from areal_tpu.base import logging
+from areal_tpu.base.retry import (
+    DEFAULT_GENERATION_RETRY,
+    FaultInjector,
+    RetryPolicy,
+)
 
 logger = logging.getLogger("system.partial_rollout")
+
+
+class GenerationAbandonedError(RuntimeError):
+    """A chunked generation exhausted its failover retry budget."""
+
+
+class NoHealthyServersError(RuntimeError):
+    """The manager currently has zero routable servers (503). Transient by
+    design — the health loop re-admits servers as they recover — so the
+    client waits it out on its own (longer) budget rather than burning the
+    millisecond-fast chunk-failover attempts."""
 
 
 @dataclasses.dataclass
@@ -35,16 +61,36 @@ class PartialRolloutClient:
     """Async client: one ``generate`` = N chunked HTTP calls routed through
     the gserver manager."""
 
-    def __init__(self, manager_url: str, session, chunk_tokens: int = 128):
+    def __init__(self, manager_url: str, session, chunk_tokens: int = 128,
+                 retry: Optional[RetryPolicy] = None,
+                 fault_injector: Optional[FaultInjector] = None,
+                 no_server_wait_secs: float = 180.0):
         self.manager_url = manager_url
         self.session = session  # aiohttp.ClientSession
         self.chunk_tokens = chunk_tokens
+        self.retry = retry or DEFAULT_GENERATION_RETRY
+        # Whole-fleet-empty budget: must comfortably outlast an eviction +
+        # re-admission cycle — detection (health interval x threshold, ~6s
+        # at defaults) plus the re-admission weight reconcile, which is
+        # budgeted up to fanout_retry.max_attempts x fanout_timeout_secs
+        # (~120s at manager defaults).
+        self.no_server_wait_secs = no_server_wait_secs
+        self.faults = fault_injector
+        # Failover observability (asserted by chaos tests, exported by the
+        # rollout worker's status callback).
+        self.n_failovers = 0
+        self.n_abandoned = 0
 
     async def _schedule(self) -> Dict:
+        if self.faults is not None:
+            self.faults.maybe_fail("schedule")
         async with self.session.post(
             f"{self.manager_url}/schedule_request", json={}
         ) as r:
-            return await r.json()
+            d = await r.json()
+        if not d.get("url"):
+            raise NoHealthyServersError(d.get("reason", "unknown"))
+        return d
 
     async def _release(self, route: Dict) -> None:
         await self.session.post(
@@ -52,11 +98,30 @@ class PartialRolloutClient:
             json={"lease_id": route.get("lease_id"), "url": route["url"]},
         )
 
-    async def _renew(self, route: Dict) -> None:
+    async def _release_quiet(self, route: Optional[Dict]) -> None:
+        """Best-effort release of a possibly-dead route — the manager frees
+        the lease/inflight slot even though the server is gone; if the
+        MANAGER is also unreachable, lease TTL expiry reclaims it."""
+        if route is None:
+            return
+        try:
+            await self._release(route)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _renew(self, route: Dict) -> bool:
+        """Renew the sticky route's lease; False means stickiness must be
+        dropped (lease expired or the server was evicted — an evicted
+        server may be alive-but-stale, so routing must go back through the
+        manager)."""
         lid = route.get("lease_id")
-        if lid is not None:
-            await self.session.post(f"{self.manager_url}/renew",
-                                    json={"lease_id": lid})
+        if lid is None:
+            return True  # no lease bookkeeping on this route
+        async with self.session.post(f"{self.manager_url}/renew",
+                                     json={"lease_id": lid}) as r:
+            return bool((await r.json()).get("ok"))
 
     async def generate_one(
         self,
@@ -74,27 +139,77 @@ class PartialRolloutClient:
         # server as busy; renewed each chunk, released on route drop/end.
         route: Optional[Dict] = None
         rid = uuid.uuid4().hex  # keys the server's persistent decode state
+        failures = 0  # CONSECUTIVE chunk failures; any success resets
+        fleet_waited = 0.0  # time spent waiting out an empty fleet
         try:
             while len(acc_ids) < gconfig.max_new_tokens:
-                # sticky routing while version unchanged (reference :181)
-                if route is None:
-                    route = await self._schedule()
-                url = route["url"]
                 left = gconfig.max_new_tokens - len(acc_ids)
-                body = {
-                    "rid": rid,
-                    "tokens_done": len(acc_ids),
-                    "prompt_ids": list(prompt_ids) + acc_ids,
-                    "gconfig": {
-                        **dataclasses.asdict(gconfig),
-                        "max_new_tokens": min(self.chunk_tokens, left),
-                        "n": 1,
-                    },
-                    "max_tokens": min(self.chunk_tokens, left),
-                }
-                async with self.session.post(f"{url}/generate",
-                                             json=body) as r:
-                    out = await r.json()
+                try:
+                    # sticky routing while version unchanged (reference :181)
+                    if route is None:
+                        route = await self._schedule()
+                    url = route["url"]
+                    body = {
+                        "rid": rid,
+                        "tokens_done": len(acc_ids),
+                        "prompt_ids": list(prompt_ids) + acc_ids,
+                        "gconfig": {
+                            **dataclasses.asdict(gconfig),
+                            "max_new_tokens": min(self.chunk_tokens, left),
+                            "n": 1,
+                        },
+                        "max_tokens": min(self.chunk_tokens, left),
+                    }
+                    if self.faults is not None:
+                        self.faults.maybe_fail("generate", url=url,
+                                               tokens_done=len(acc_ids))
+                    async with self.session.post(f"{url}/generate",
+                                                 json=body) as r:
+                        if r.status != 200:
+                            raise RuntimeError(
+                                f"/generate status {r.status}"
+                            )
+                        out = await r.json()
+                except asyncio.CancelledError:
+                    raise
+                except NoHealthyServersError as e:
+                    # Empty fleet 503s come back in milliseconds — counting
+                    # them against the chunk-failover budget would abandon
+                    # every rollout within ~2s of a transient whole-fleet
+                    # gap. Poll on a separate, longer budget instead.
+                    await self._release_quiet(route)
+                    route = None
+                    if fleet_waited >= self.no_server_wait_secs:
+                        self.n_abandoned += 1
+                        raise GenerationAbandonedError(
+                            f"no routable generation server for "
+                            f"{fleet_waited:.0f}s "
+                            f"({len(acc_ids)} tokens accumulated)"
+                        ) from e
+                    fleet_waited += self.retry.max_delay_secs
+                    await asyncio.sleep(self.retry.max_delay_secs)
+                    continue
+                except Exception as e:  # noqa: BLE001 — failover path
+                    failures += 1
+                    await self._release_quiet(route)
+                    route = None
+                    if failures >= self.retry.max_attempts:
+                        self.n_abandoned += 1
+                        raise GenerationAbandonedError(
+                            f"generation abandoned after {failures} "
+                            f"consecutive chunk failures "
+                            f"({len(acc_ids)} tokens accumulated): {e}"
+                        ) from e
+                    self.n_failovers += 1
+                    logger.warning(
+                        f"chunk failed ({e}); re-scheduling "
+                        f"(attempt {failures}/{self.retry.max_attempts}, "
+                        f"{len(acc_ids)} tokens resume)"
+                    )
+                    await asyncio.sleep(self.retry.delay(failures))
+                    continue
+                failures = 0
+                fleet_waited = 0.0
                 n_chunks += 1
                 acc_ids += list(out["output_ids"])
                 acc_lps += list(out["output_logprobs"])
@@ -104,14 +219,25 @@ class PartialRolloutClient:
                 version_end = v
                 if out["finished"] or not out["output_ids"]:
                     break
+                sticky = False
                 if v == route.get("version", v):
-                    await self._renew(route)  # stay sticky
-                else:
-                    await self._release(route)
-                    route = None  # version moved: re-schedule next chunk
+                    try:
+                        sticky = await self._renew(route)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — manager blip
+                        sticky = False
+                if not sticky:
+                    # Version moved, lease refused (route was evicted —
+                    # possibly alive-but-stale), or the manager blipped:
+                    # drop stickiness and go back through the scheduler.
+                    # Must not escape the failover loop as a raw error.
+                    await self._release_quiet(route)
+                    route = None
         finally:
-            if route is not None:
-                await self._release(route)
+            # Best-effort: the route (or the manager) may be dead; lease
+            # TTL expiry is the backstop for a lost release.
+            await self._release_quiet(route)
         return GenResult(
             output_ids=acc_ids,
             output_logprobs=acc_lps,
@@ -127,12 +253,17 @@ class PartialRolloutClient:
         group_size: int,
         eos_token_id: int = 1,
     ) -> List[GenResult]:
-        import asyncio
-
-        return list(await asyncio.gather(*[
+        # return_exceptions so every sibling generation runs to completion
+        # (releasing its route) before an abandonment is surfaced — a bare
+        # gather would leak the siblings as detached background tasks.
+        results = await asyncio.gather(*[
             self.generate_one(prompt_ids, gconfig, eos_token_id)
             for _ in range(group_size)
-        ]))
+        ], return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return list(results)
 
 
 def trajectory_from_gen(

@@ -22,7 +22,13 @@ from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import GenerationHyperparameters, make_agent
 from areal_tpu.base import logging, name_resolve, names
 from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl, load_shuffle_split
+from areal_tpu.base.retry import (
+    DEFAULT_GENERATION_RETRY,
+    FaultInjector,
+    RetryPolicy,
+)
 from areal_tpu.system.partial_rollout import (
+    GenerationAbandonedError,
     PartialRolloutClient,
     trajectory_from_gen,
 )
@@ -56,6 +62,13 @@ class RolloutWorkerConfig:
     # them so recovered runs don't re-train the same prompts (reference
     # rollout_worker.py:180-184 hash_vals_to_ignore skiplist).
     recover_dir: str = ""
+    # Chunk-failover policy (docs/fault_tolerance.md): a failed /generate
+    # chunk re-schedules onto a healthy server with capped exponential
+    # backoff; after max_attempts CONSECUTIVE failures the rollout is
+    # abandoned (clean /finish_rollout, worker stays alive).
+    retry: RetryPolicy = dataclasses.field(
+        default_factory=lambda: DEFAULT_GENERATION_RETRY
+    )
 
 
 class ConsumedLog:
@@ -86,8 +99,10 @@ class ConsumedLog:
 
 
 class RolloutWorker:
-    def __init__(self, cfg: RolloutWorkerConfig):
+    def __init__(self, cfg: RolloutWorkerConfig,
+                 fault_injector: Optional[FaultInjector] = None):
         self.cfg = cfg
+        self.faults = fault_injector
         records = load_jsonl(cfg.dataset_path)
         self.records = load_shuffle_split(
             records, cfg.seed, cfg.worker_index, cfg.n_workers
@@ -102,6 +117,7 @@ class RolloutWorker:
         self.consumed = ConsumedLog(cfg.recover_dir, cfg.worker_index)
         self._done = 0
         self._pushed = 0
+        self._abandoned = 0
 
     def _prompt_sample(self, rec, uid: str) -> SequenceSample:
         ids = self.cfg.tokenizer.encode(rec["prompt"])
@@ -112,21 +128,69 @@ class RolloutWorker:
             metadata={"task": [rec.get("task", "math")]},
         )
 
+    @staticmethod
+    async def _post_json(session, url: str, payload: Dict,
+                         timeout_secs: float = 15.0) -> Dict:
+        # Explicit bound: quota RPCs run inside cancellation shields, so a
+        # hung manager must not pin worker shutdown on aiohttp's 300s
+        # default total timeout.
+        import aiohttp
+
+        async with session.post(
+            url, json=payload,
+            timeout=aiohttp.ClientTimeout(total=timeout_secs),
+        ) as r:
+            return await r.json()
+
     async def _rollout_one(self, rec, uid, client, pusher, mgr_url, session):
         cfg = self.cfg
         # quota / staleness gate — allocate in SAMPLE units: one prompt
         # produces group_size samples, and the manager's is_staled /
         # max_concurrent_rollouts bookkeeping counts samples (reference
         # gserver_manager.py:351 compares against train_batch_size samples).
-        async with session.post(
-            f"{mgr_url}/allocate_rollout",
-            json={"n_samples": cfg.group_size},
-        ) as r:
-            alloc = await r.json()
+        #
+        # The allocation RPC must be cancellation-ATOMIC: if this task is
+        # cancelled after the manager booked quota but before our
+        # try/finally owns it, running_rollouts would leak forever. Shield
+        # the RPC, and on cancellation let it complete and compensate.
+        alloc_fut = asyncio.ensure_future(self._post_json(
+            session, f"{mgr_url}/allocate_rollout",
+            {"n_samples": cfg.group_size},
+        ))
+        try:
+            alloc = await asyncio.shield(alloc_fut)
+        except asyncio.CancelledError:
+            try:
+                alloc = await alloc_fut
+            except Exception:  # noqa: BLE001 — RPC itself failed: no booking
+                alloc = None
+            if alloc is not None and alloc.get("allowed"):
+                try:
+                    await self._post_json(
+                        session, f"{mgr_url}/finish_rollout",
+                        {"accepted": False, "n_samples": cfg.group_size,
+                         "n_accepted": 0},
+                    )
+                except Exception as e2:  # noqa: BLE001 — manager hung/dead
+                    logger.warning(
+                        f"compensating finish_rollout failed ({e2}); "
+                        f"{cfg.group_size} samples of quota may leak until "
+                        f"the manager restarts"
+                    )
+            raise
+        except Exception as e:  # noqa: BLE001 — manager blip: not fatal
+            # A failed allocation made no booking — retry later instead of
+            # letting the error reach d.result() and kill the worker (the
+            # same survival contract the /generate chunks have).
+            logger.warning(f"allocate_rollout failed ({e}); retrying")
+            await asyncio.sleep(1.0)
+            return "retry"
         if not alloc.get("allowed"):
             await asyncio.sleep(0.5)
-            return False
+            return "retry"
         accepted = 0
+        abandoned = False
+        task = None
         try:
             prompt = self._prompt_sample(rec, uid)
             obs_q: asyncio.Queue = asyncio.Queue()
@@ -171,20 +235,50 @@ class RolloutWorker:
                 pusher.push(t.as_json_compatible())
             accepted = len(final)
             self._pushed += accepted
+        except GenerationAbandonedError as e:
+            # The generation fleet stayed dead through the whole failover
+            # budget. Abandon THIS rollout cleanly — the finally below
+            # reports /finish_rollout with the exact allocation so
+            # running_rollouts drains to 0 — and keep the worker alive.
+            self._abandoned += 1
+            abandoned = True
+            logger.warning(f"rollout {uid} abandoned: {e}")
         finally:
             # Release EXACTLY what was allocated (group_size samples) so the
             # manager's running_rollouts never drifts; acceptance only gates
             # how many samples count as headed for the trainer (n_accepted).
-            await session.post(
-                f"{mgr_url}/finish_rollout",
-                json={
-                    "accepted": accepted > 0,
-                    "n_samples": cfg.group_size,
-                    "n_accepted": accepted,
-                },
-            )
+            # Shielded like the allocation: a cancellation arriving during
+            # cleanup must not skip the /finish_rollout report.
+            async def _cleanup():
+                if task is not None and not task.done():
+                    task.cancel()
+                    await asyncio.gather(task, return_exceptions=True)
+                try:
+                    await self._post_json(
+                        session, f"{mgr_url}/finish_rollout",
+                        {"accepted": accepted > 0,
+                         "n_samples": cfg.group_size,
+                         "n_accepted": accepted},
+                    )
+                except Exception as e:  # noqa: BLE001 — manager hung/dead
+                    logger.warning(
+                        f"finish_rollout failed ({e}); {cfg.group_size} "
+                        f"samples of quota may leak until the manager "
+                        f"restarts"
+                    )
+
+            cleanup = asyncio.ensure_future(_cleanup())
+            try:
+                await asyncio.shield(cleanup)
+            except asyncio.CancelledError:
+                await cleanup
+                raise
         self._done += 1
-        return True
+        # "abandoned" counts toward done (bounds test loops) but must NOT
+        # mark the prompt consumed: a transient fleet outage would otherwise
+        # permanently delete prompts from training (the ConsumedLog skiplist
+        # persists across recovery).
+        return "abandoned" if abandoned else "ok"
 
     async def run_async(self) -> None:
         import aiohttp
@@ -201,8 +295,10 @@ class RolloutWorker:
         pusher = ZmqPusher(cfg.experiment, cfg.trial, cfg.trainer_handler)
         async with aiohttp.ClientSession() as session:
             client = PartialRolloutClient(
-                mgr_url, session, chunk_tokens=cfg.chunk_tokens
+                mgr_url, session, chunk_tokens=cfg.chunk_tokens,
+                retry=cfg.retry, fault_injector=self.faults,
             )
+            self.client = client  # exposed for tests/telemetry
             sem = asyncio.Semaphore(cfg.max_concurrent)
             pos = 0
 
@@ -210,11 +306,14 @@ class RolloutWorker:
                 async with sem:
                     # A denied allocation (staleness/capacity gate) must not
                     # drop the prompt — retry until the gate opens.
-                    while not await self._rollout_one(
-                        rec, uid, client, pusher, mgr_url, session
-                    ):
-                        pass
-                    self.consumed.add(uid)
+                    while True:
+                        status = await self._rollout_one(
+                            rec, uid, client, pusher, mgr_url, session
+                        )
+                        if status != "retry":
+                            break
+                    if status == "ok":
+                        self.consumed.add(uid)
 
             pending = set()
             while cfg.max_rollouts is None or self._done < cfg.max_rollouts:
@@ -223,7 +322,9 @@ class RolloutWorker:
                 # when resumed); exit drains out of the loop.
                 await asyncio.to_thread(
                     ctrl.step,
-                    lambda: {"done": self._done, "pushed": self._pushed},
+                    lambda: {"done": self._done, "pushed": self._pushed,
+                             "abandoned": self._abandoned,
+                             "failovers": client.n_failovers},
                 )
                 if ctrl.should_exit:
                     break
@@ -244,8 +345,19 @@ class RolloutWorker:
                 )
                 for d in done:
                     d.result()  # surface exceptions
+            # Drain on exit: cancel in-flight rollouts while the session is
+            # still open so their finally blocks report /finish_rollout —
+            # the manager's running_rollouts drains to 0, no leaked quota.
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
         ctrl.close()
-        logger.info(f"rollout worker done: {self._pushed} trajectories pushed")
+        logger.info(
+            f"rollout worker done: {self._pushed} trajectories pushed "
+            f"({self._abandoned} abandoned, "
+            f"{self.client.n_failovers} chunk failovers)"
+        )
 
     def run(self) -> None:
         asyncio.run(self.run_async())

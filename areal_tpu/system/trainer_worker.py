@@ -471,8 +471,14 @@ class TrainerWorker:
         for mfc_name, iface in self.interfaces.items():
             if hasattr(iface, "state_dict"):
                 iface_states[mfc_name] = iface.state_dict()
-        with open(os.path.join(ckpt_dir, "trainer_state.json"), "w") as f:
+        # Atomic write: trainer_state.json doubles as the legacy
+        # completeness signal (recover.ckpt_is_complete), so a crash
+        # mid-dump must leave no torn file behind.
+        path = os.path.join(ckpt_dir, "trainer_state.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump({"meta": meta, "interfaces": iface_states}, f)
+        os.replace(tmp, path)
         logger.info(f"checkpointed trainer state -> {ckpt_dir}")
         return {"ok": True}
 

@@ -169,10 +169,25 @@ class TestRecover:
 
     def test_discover_ckpt(self, tmp_path):
         for e, es, g in [(1, 1, 1), (1, 2, 2), (2, 1, 3)]:
-            (tmp_path / recover.ckpt_dirname(e, es, g)).mkdir()
+            d = tmp_path / recover.ckpt_dirname(e, es, g)
+            d.mkdir()
+            recover.mark_ckpt_complete(str(d))
         (tmp_path / "garbage").mkdir()
         best = recover.discover_ckpt(str(tmp_path))
         assert best.endswith("epoch2epochstep1globalstep3")
+
+    def test_discover_ckpt_skips_incomplete(self, tmp_path):
+        """A crash mid-save leaves a dir without the .complete sentinel —
+        discovery must fall back to the previous complete checkpoint."""
+        ok = tmp_path / recover.ckpt_dirname(1, 1, 1)
+        ok.mkdir()
+        recover.mark_ckpt_complete(str(ok))
+        half = tmp_path / recover.ckpt_dirname(1, 2, 2)  # newer, no marker
+        half.mkdir()
+        best = recover.discover_ckpt(str(tmp_path))
+        assert best.endswith("epoch1epochstep1globalstep1")
+        assert recover.ckpt_is_complete(str(ok))
+        assert not recover.ckpt_is_complete(str(half))
 
     def test_load_missing(self, tmp_path):
         assert recover.load(str(tmp_path / "nope")) is None

@@ -1,0 +1,720 @@
+"""Generation-fleet fault tolerance: chaos + regression tests.
+
+Covers the failure-recovery subsystem (docs/fault_tolerance.md):
+ - retry policy / fault injector primitives (base/retry.py)
+ - lease release/expiry accounting (no double decrement)
+ - weight fanout with an unresponsive server: bounded by the per-server
+   timeout budget, dead server evicted, version still advances
+ - health-check eviction and re-admission with weight reconcile
+ - client chunk failover: replay from accumulated tokens on a new route
+ - rollout abandonment: clean /finish_rollout, worker survives
+ - full chaos run: one of two real generation servers killed mid-run
+
+Every test is bounded to seconds: failures come from the FaultInjector or
+from tiny aiohttp fakes, never from real TTLs or long sleeps.
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from areal_tpu.base import name_resolve, names, network
+from areal_tpu.base.retry import (
+    FaultInjected,
+    FaultInjector,
+    RetryPolicy,
+    aretry,
+)
+from areal_tpu.system.gserver_manager import (
+    GserverManager,
+    GserverManagerConfig,
+    _ServerHealth,
+)
+from areal_tpu.system.partial_rollout import (
+    GenerationAbandonedError,
+    NoHealthyServersError,
+    PartialRolloutClient,
+)
+
+EXP, TRIAL = "faulttest", "t0"
+
+
+class _Req:
+    """Minimal aiohttp-request stand-in for direct handler calls."""
+
+    def __init__(self, d=None):
+        self._d = d or {}
+
+    async def json(self):
+        return self._d
+
+
+def _mgr(**kw) -> GserverManager:
+    cfg = GserverManagerConfig(experiment=EXP, trial=TRIAL, **kw)
+    return GserverManager(cfg)
+
+
+async def _start_app(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = network.find_free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner, f"http://127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------- retry.py
+
+
+@pytest.mark.chaos
+def test_retry_policy_delays_capped():
+    p = RetryPolicy(max_attempts=5, base_delay_secs=0.1, max_delay_secs=0.5,
+                    multiplier=2.0)
+    assert p.delay(1) == pytest.approx(0.1)
+    assert p.delay(2) == pytest.approx(0.2)
+    assert p.delay(3) == pytest.approx(0.4)
+    assert p.delay(4) == pytest.approx(0.5)  # capped
+    assert p.delay(10) == pytest.approx(0.5)
+
+
+@pytest.mark.chaos
+def test_aretry_retries_then_succeeds_and_gives_up():
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ValueError("boom")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=3, base_delay_secs=0.001)
+    assert asyncio.run(aretry(flaky, pol)) == "ok"
+    assert calls["n"] == 3
+
+    async def dead():
+        raise ValueError("always")
+
+    with pytest.raises(ValueError):
+        asyncio.run(aretry(dead, pol))
+
+
+@pytest.mark.chaos
+def test_fault_injector_arming():
+    inj = FaultInjector()
+    inj.arm("p", times=2)
+    with pytest.raises(FaultInjected):
+        inj.maybe_fail("p")
+    with pytest.raises(FaultInjected):
+        inj.maybe_fail("p")
+    inj.maybe_fail("p")  # exhausted: no-op
+    assert inj.fired["p"] == 2
+    # predicate-gated, unlimited until disarm
+    inj.arm("q", times=-1, when=lambda ctx: ctx.get("url") == "dead")
+    inj.maybe_fail("q", url="alive")
+    with pytest.raises(FaultInjected):
+        inj.maybe_fail("q", url="dead")
+    inj.disarm("q")
+    inj.maybe_fail("q", url="dead")
+
+
+# ------------------------------------------------------- lease accounting
+
+
+@pytest.mark.chaos
+def test_release_by_url_drops_lease_no_double_decrement():
+    """Regression: the legacy by-url /release decremented inflight but left
+    the lease alive, so its later TTL expiry decremented the SAME slot a
+    second time — corrupting inflight while another request was running."""
+
+    async def main():
+        mgr = _mgr(lease_ttl_secs=60.0)
+        url = "http://127.0.0.1:7777"
+        mgr.servers = [url]
+        mgr._inflight = {url: 0}
+        mgr.health = {url: _ServerHealth()}
+
+        await mgr.handle_schedule_request(_Req())  # request A
+        assert mgr._inflight[url] == 1 and len(mgr._leases) == 1
+        lease_a = next(iter(mgr._leases))
+
+        # client releases A by url (legacy path, no lease_id)
+        await mgr.handle_release(_Req({"url": url}))
+        assert mgr._inflight[url] == 0
+        assert lease_a not in mgr._leases  # the fix: lease retired too
+
+        await mgr.handle_schedule_request(_Req())  # request B, in flight
+        assert mgr._inflight[url] == 1
+
+        # Force lease-expiry sweep. With the orphaned lease A still alive
+        # (old bug) this would decrement B's slot to 0 while B is running.
+        mgr._expire_leases()
+        assert mgr._inflight[url] == 1
+
+        # B's own expiry still works exactly once.
+        lid_b = next(iter(mgr._leases))
+        u, _ = mgr._leases[lid_b]
+        mgr._leases[lid_b] = (u, time.monotonic() - 1)
+        mgr._expire_leases()
+        assert mgr._inflight[url] == 0 and not mgr._leases
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_release_by_lease_id_after_eviction_is_harmless():
+    async def main():
+        mgr = _mgr()
+        url = "http://127.0.0.1:7777"
+        mgr.servers = [url]
+        mgr._inflight = {url: 0}
+        mgr.health = {url: _ServerHealth()}
+        await mgr.handle_schedule_request(_Req())
+        lid = next(iter(mgr._leases))
+        mgr._evict(url, "test")
+        assert not mgr._leases and url not in mgr._inflight
+        # late release from the client of the evicted server: no KeyError,
+        # no negative counts
+        await mgr.handle_release(_Req({"lease_id": lid, "url": url}))
+        await mgr.handle_release(_Req({"url": url}))
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ weight fanout
+
+
+@pytest.mark.chaos
+def test_fanout_evicts_unresponsive_server_within_budget():
+    """One acking server + one that accepts but never replies: the fanout
+    must finish within the per-server timeout budget, evict the hung
+    server (dropping its leases), bump the version, and route only to the
+    survivor."""
+    from aiohttp import web
+
+    async def main():
+        acks = []
+
+        async def ok_update(req):
+            acks.append(await req.json())
+            return web.json_response({"ok": True})
+
+        async def hang(req):
+            await asyncio.sleep(60)
+
+        live_app = web.Application()
+        live_app.router.add_post("/update_weights", ok_update)
+        live_runner, live_url = await _start_app(live_app)
+        hung_app = web.Application()
+        hung_app.router.add_post("/update_weights", hang)
+        hung_runner, hung_url = await _start_app(hung_app)
+        try:
+            mgr = _mgr(
+                fanout_timeout_secs=0.4,
+                fanout_retry=RetryPolicy(max_attempts=2,
+                                         base_delay_secs=0.05),
+            )
+            mgr.servers = sorted([live_url, hung_url])
+            mgr._inflight = {u: 0 for u in mgr.servers}
+            mgr.health = {u: _ServerHealth() for u in mgr.servers}
+            # an in-flight lease on the hung server must drain on eviction
+            while True:
+                await mgr.handle_schedule_request(_Req())
+                if any(u == hung_url for u, _ in mgr._leases.values()):
+                    break
+
+            import aiohttp
+
+            budget = mgr.cfg.fanout_retry.max_attempts * (
+                mgr.cfg.fanout_timeout_secs
+                + mgr.cfg.fanout_retry.max_delay_secs
+            )
+            t0 = time.monotonic()
+            async with aiohttp.ClientSession() as sess:
+                acked = await mgr.fanout_weights(sess, 1, "/tmp/unused")
+            elapsed = time.monotonic() - t0
+            assert elapsed < budget + 1.0
+
+            assert acked == [live_url]
+            assert mgr.version == 1  # acked servers ⇒ version advanced
+            assert [d["version"] for d in acks] == [1]
+            assert hung_url not in mgr.servers
+            assert not mgr.health[hung_url].routable
+            assert all(u != hung_url for u, _ in mgr._leases.values())
+            assert hung_url not in mgr._inflight
+            for _ in range(4):  # no further routing to the evicted server
+                assert mgr._pick_server() == live_url
+        finally:
+            await live_runner.cleanup()
+            await hung_runner.cleanup()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_fanout_total_failure_holds_version_and_fleet():
+    """If NO server acks, the failure is systemic (bad/late weight path) —
+    the version must NOT advance and the fleet must NOT be mass-evicted
+    (that would drop every lease and flap); the watcher retries next poll
+    and genuinely dead servers are the health loop's responsibility."""
+
+    async def main():
+        import aiohttp
+
+        mgr = _mgr(
+            fanout_timeout_secs=0.2,
+            fanout_retry=RetryPolicy(max_attempts=1, base_delay_secs=0.01),
+        )
+        dead = "http://127.0.0.1:1"
+        mgr.servers = [dead]
+        mgr._inflight = {dead: 0}
+        mgr.health = {dead: _ServerHealth()}
+        async with aiohttp.ClientSession() as sess:
+            acked = await mgr.fanout_weights(sess, 5, "/tmp/unused")
+        assert acked == [] and mgr.version == 0
+        assert mgr.servers == [dead]  # fleet held, not mass-evicted
+
+    asyncio.run(main())
+
+
+# --------------------------------------------- health eviction/re-admission
+
+
+@pytest.mark.chaos
+def test_health_eviction_and_readmission_with_reconcile(tmp_name_resolve):
+    """/health failures evict after the threshold; a recovered server is
+    re-admitted only after its weights are reconciled to the manager's
+    current version; a newly registered server joins through the same
+    gate."""
+    from aiohttp import web
+
+    async def main():
+        state = {"alive": True, "version": 0, "updates": []}
+
+        async def health(req):
+            if not state["alive"]:
+                return web.Response(status=500)
+            return web.json_response({"ok": True,
+                                      "version": state["version"]})
+
+        async def update(req):
+            d = await req.json()
+            state["updates"].append(d)
+            state["version"] = d["version"]
+            return web.json_response({"ok": True})
+
+        app = web.Application()
+        app.router.add_get("/health", health)
+        app.router.add_post("/update_weights", update)
+        runner, url = await _start_app(app)
+        name_resolve.add(names.gen_servers(EXP, TRIAL, "flaky"), url,
+                         replace=True)
+        try:
+            import aiohttp
+
+            mgr = _mgr(health_failure_threshold=2,
+                       health_check_timeout_secs=0.5)
+            mgr.servers = [url]
+            mgr._inflight = {url: 0}
+            mgr.health = {url: _ServerHealth()}
+
+            async def settle(pred, sweeps=20):
+                # re-admission reconciles run detached from the sweep;
+                # sweep + poll until the predicate holds
+                for _ in range(sweeps):
+                    await mgr.check_fleet(sess)
+                    for _ in range(20):
+                        if pred():
+                            return True
+                        await asyncio.sleep(0.02)
+                return pred()
+
+            timeout = aiohttp.ClientTimeout(total=0.5)
+            async with aiohttp.ClientSession(timeout=timeout) as sess:
+                await mgr.check_fleet(sess)
+                assert url in mgr.servers  # healthy: stays
+
+                state["alive"] = False
+                await mgr.check_fleet(sess)
+                assert url in mgr.servers  # 1 failure < threshold
+                await mgr.check_fleet(sess)
+                assert url not in mgr.servers  # threshold hit: evicted
+                assert not mgr.health[url].routable
+
+                # manager moved on to v3 while the server was down
+                mgr.version = 3
+                state["alive"] = True
+                assert await settle(lambda: url in mgr.servers)
+                # re-admitted AND reconciled to v3 before routing
+                assert state["updates"][-1]["version"] == 3
+                assert mgr.health[url].acked_version == 3
+                assert mgr._inflight[url] == 0
+
+                # a brand-new registration joins through the health gate
+                app2 = web.Application()
+                app2.router.add_get("/health", health)
+                app2.router.add_post("/update_weights", update)
+                runner2, url2 = await _start_app(app2)
+                try:
+                    name_resolve.add(
+                        names.gen_servers(EXP, TRIAL, "late"), url2,
+                        replace=True,
+                    )
+                    assert await settle(lambda: url2 in mgr.servers)
+
+                    # deregistration prunes the health map entirely
+                    name_resolve.delete(names.gen_servers(EXP, TRIAL,
+                                                          "late"))
+                    await mgr.check_fleet(sess)
+                    assert url2 not in mgr.servers
+                    assert url2 not in mgr.health
+                finally:
+                    await runner2.cleanup()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- client failover
+
+
+def _fake_gen_app(state):
+    """Deterministic fake generation server: token i of a request is
+    100+tokens_done+i, so replay-from-accumulated is directly observable
+    in the output sequence."""
+    from aiohttp import web
+
+    async def generate(req):
+        d = await req.json()
+        td = int(d["tokens_done"])
+        mt = int(d["max_tokens"])
+        state["calls"].append(td)
+        toks = list(range(100 + td, 100 + td + mt))
+        return web.json_response({
+            "output_ids": toks, "output_logprobs": [0.0] * mt,
+            "finished": False, "version": 0,
+        })
+
+    async def health(req):
+        return web.json_response({"ok": True, "version": 0})
+
+    app = web.Application()
+    app.router.add_post("/generate", generate)
+    app.router.add_get("/health", health)
+    return app
+
+
+@pytest.mark.chaos
+def test_client_failover_replays_from_accumulated_tokens(tmp_name_resolve):
+    """A chunk failure mid-generation re-schedules and RESUMES: the final
+    token sequence is contiguous (no lost or repeated tokens) and the
+    failed chunk was re-requested at the same tokens_done."""
+    from areal_tpu.api.model import GenerationHyperparameters
+
+    async def main():
+        import aiohttp
+
+        state = {"calls": []}
+        runner, url = await _start_app(_fake_gen_app(state))
+        name_resolve.add(names.gen_servers(EXP, TRIAL, "gen0"), url,
+                         replace=True)
+        mgr = _mgr(n_servers=1, max_head_offpolicyness=100,
+                   health_check_interval_secs=30.0)
+        mgr_url = await mgr.start()
+        try:
+            inj = FaultInjector()
+            # fail exactly one attempt, at the second chunk boundary
+            inj.arm("generate", times=1,
+                    when=lambda ctx: ctx["tokens_done"] == 4)
+            async with aiohttp.ClientSession() as sess:
+                client = PartialRolloutClient(
+                    mgr_url, sess, chunk_tokens=4,
+                    retry=RetryPolicy(max_attempts=4, base_delay_secs=0.01),
+                    fault_injector=inj,
+                )
+                res = await client.generate_one(
+                    [1, 2, 3],
+                    GenerationHyperparameters(max_new_tokens=8),
+                )
+            assert res.output_ids == list(range(100, 108))
+            assert client.n_failovers == 1 and inj.fired["generate"] == 1
+            assert state["calls"] == [0, 4]  # chunk 2 replayed at td=4
+            assert res.n_chunks == 2
+            # quota accounting survived the failover: no leaked leases
+            assert not mgr._leases
+            assert all(v == 0 for v in mgr._inflight.values())
+        finally:
+            await mgr.stop()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_generation_abandoned_after_max_attempts(tmp_name_resolve):
+    async def main():
+        import aiohttp
+
+        state = {"calls": []}
+        runner, url = await _start_app(_fake_gen_app(state))
+        name_resolve.add(names.gen_servers(EXP, TRIAL, "gen0"), url,
+                         replace=True)
+        mgr = _mgr(n_servers=1, health_check_interval_secs=30.0)
+        mgr_url = await mgr.start()
+        try:
+            from areal_tpu.api.model import GenerationHyperparameters
+
+            inj = FaultInjector()
+            inj.arm("generate", times=-1)  # fleet permanently dead
+            async with aiohttp.ClientSession() as sess:
+                client = PartialRolloutClient(
+                    mgr_url, sess, chunk_tokens=4,
+                    retry=RetryPolicy(max_attempts=3, base_delay_secs=0.01),
+                    fault_injector=inj,
+                )
+                with pytest.raises(GenerationAbandonedError):
+                    await client.generate_one(
+                        [1, 2], GenerationHyperparameters(max_new_tokens=8)
+                    )
+            assert inj.fired["generate"] == 3
+            assert client.n_abandoned == 1
+            # every scheduled route was released on its failure
+            assert all(v == 0 for v in mgr._inflight.values())
+            assert not mgr._leases
+        finally:
+            await mgr.stop()
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_empty_fleet_waits_on_own_budget_not_failover_attempts():
+    """An all-evicted fleet returns 503s in milliseconds; those must burn
+    the (longer) no-server wait budget, not the chunk-failover attempts —
+    and a fleet gap longer than the budget abandons with a clear error."""
+    from areal_tpu.api.model import GenerationHyperparameters
+
+    async def main():
+        import aiohttp
+
+        mgr = _mgr()  # zero servers: /schedule_request 503s immediately
+        runner, mgr_url = await _start_app(mgr.build_app())
+        try:
+            async with aiohttp.ClientSession() as sess:
+                client = PartialRolloutClient(
+                    mgr_url, sess, chunk_tokens=4,
+                    retry=RetryPolicy(max_attempts=3, base_delay_secs=0.01,
+                                      max_delay_secs=0.05),
+                    no_server_wait_secs=0.2,
+                )
+                with pytest.raises(NoHealthyServersError):
+                    await client._schedule()
+                t0 = time.monotonic()
+                with pytest.raises(GenerationAbandonedError,
+                                   match="no routable"):
+                    await client.generate_one(
+                        [1, 2], GenerationHyperparameters(max_new_tokens=8)
+                    )
+                # waited out the no-server budget (not the ~30ms the three
+                # failover attempts would have taken)
+                assert time.monotonic() - t0 >= 0.2
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------ rollout worker survival
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(120)
+def test_rollout_worker_abandons_cleanly_never_crashes(tmp_path):
+    """With every /generate chunk failing, the worker must abandon each
+    rollout after the retry budget — reporting a correct /finish_rollout so
+    running_rollouts drains to 0 — and run_async must return, not raise."""
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+    from areal_tpu.system.rollout_worker import (
+        RolloutWorker,
+        RolloutWorkerConfig,
+    )
+    from areal_tpu.system.streams import ZmqPuller
+
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(
+        str(tmp_path / "nr")
+    )
+    data_path = str(tmp_path / "math.jsonl")
+    make_math_jsonl(data_path, n=4)
+
+    async def main():
+        state = {"calls": []}
+        runner, url = await _start_app(_fake_gen_app(state))
+        name_resolve.add(names.gen_servers(EXP, TRIAL, "gen0"), url,
+                         replace=True)
+        mgr = _mgr(n_servers=1, max_head_offpolicyness=100,
+                   health_check_interval_secs=30.0)
+        await mgr.start()
+        puller = ZmqPuller(EXP, TRIAL, "trainer")  # pusher blocks without it
+        inj = FaultInjector()
+        inj.arm("generate", times=-1)
+        worker = RolloutWorker(RolloutWorkerConfig(
+            experiment=EXP, trial=TRIAL, dataset_path=data_path,
+            gconfig=GenerationHyperparameters(max_new_tokens=8),
+            group_size=2, chunk_tokens=4, max_concurrent=2,
+            tokenizer=MockTokenizer(), max_rollouts=2,
+            retry=RetryPolicy(max_attempts=2, base_delay_secs=0.01),
+        ), fault_injector=inj)
+        await worker.run_async()  # must NOT raise
+        assert worker._abandoned >= 2 and worker._pushed == 0
+        # in-flight rollouts beyond max_rollouts drain on the same loop
+        for _ in range(200):
+            if mgr.running_rollouts == 0 and not mgr._leases:
+                break
+            await asyncio.sleep(0.05)
+        assert mgr.running_rollouts == 0  # no leaked quota
+        assert not mgr._leases
+        await mgr.stop()
+        await runner.cleanup()
+        puller.close()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(300)
+def test_chaos_kill_one_of_two_servers_mid_run(tmp_path):
+    """THE acceptance chaos run: two real generation servers, one killed
+    mid-generation. Interrupted rollouts fail over to the survivor, every
+    trajectory is delivered, running_rollouts returns to 0, the worker
+    never raises, and the dead server is evicted from routing."""
+    import jax
+
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+    from areal_tpu.system.rollout_worker import (
+        RolloutWorker,
+        RolloutWorkerConfig,
+    )
+    from areal_tpu.system.streams import ZmqPuller
+
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(
+        str(tmp_path / "nr")
+    )
+    data_path = str(tmp_path / "math.jsonl")
+    make_math_jsonl(data_path, n=6)
+    mcfg = tiny_config(vocab_size=258, n_layers=2, hidden_dim=32)
+    params = transformer.init_params(mcfg, jax.random.PRNGKey(0))
+
+    async def main():
+        servers = []
+        for sid in ("gen0", "gen1"):
+            s = GenerationServer(
+                GenerationServerConfig(
+                    experiment=EXP, trial=TRIAL, server_id=sid,
+                    chunk_tokens=4, prompt_bucket=16, batch_window_ms=2,
+                ),
+                mcfg, params,
+            )
+            await s.start()
+            servers.append(s)
+        victim_url = name_resolve.get(names.gen_servers(EXP, TRIAL, "gen0"))
+
+        mgr = GserverManager(GserverManagerConfig(
+            experiment=EXP, trial=TRIAL, n_servers=2,
+            train_batch_size=4, max_head_offpolicyness=100,
+            realloc_dir=str(tmp_path / "realloc"), weight_poll_secs=5.0,
+            health_check_interval_secs=0.1, health_check_timeout_secs=0.5,
+            health_failure_threshold=2,
+        ))
+        await mgr.start()
+
+        puller = ZmqPuller(EXP, TRIAL, "trainer")
+        worker = RolloutWorker(RolloutWorkerConfig(
+            experiment=EXP, trial=TRIAL, dataset_path=data_path,
+            gconfig=GenerationHyperparameters(max_new_tokens=8),
+            group_size=2, chunk_tokens=4, max_concurrent=2,
+            tokenizer=MockTokenizer(), max_rollouts=6,
+            retry=RetryPolicy(max_attempts=10, base_delay_secs=0.02,
+                              max_delay_secs=0.5),
+            agent_args={"success_rate_lb": 0.0, "success_rate_ub": 1.0},
+        ))
+        run_task = asyncio.create_task(worker.run_async())
+
+        # let the run make progress, then crash gen0 mid-generation
+        while worker._done < 1:
+            await asyncio.sleep(0.05)
+            assert not run_task.done() or run_task.exception() is None
+        await servers[0].stop(abort=True)
+
+        await run_task  # the worker must complete WITHOUT raising
+
+        # all 6 rollouts delivered (failover, not loss): ≥ 6 × group 2
+        assert worker._done >= 6 and worker._abandoned == 0
+        assert worker._pushed >= 12
+        got = 0
+        for _ in range(400):
+            if puller.pull(timeout_ms=20) is not None:
+                got += 1
+            elif got >= 12:
+                break
+        assert got >= 12  # every trajectory arrived over the push stream
+
+        # in-flight rollouts beyond max_rollouts drain on the same loop
+        for _ in range(400):
+            if mgr.running_rollouts == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert mgr.running_rollouts == 0  # quota fully drained
+
+        # the dead server ends up evicted from routing (health loop)
+        for _ in range(100):
+            if victim_url not in mgr.servers:
+                break
+            await asyncio.sleep(0.1)
+        assert victim_url not in mgr.servers
+        assert not mgr.health[victim_url].routable
+        # survivor still routable
+        assert len(mgr.servers) == 1
+
+        await mgr.stop()
+        await servers[1].stop()
+        puller.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------- reward client
+
+
+@pytest.mark.chaos
+def test_batch_reward_callable_from_running_event_loop(monkeypatch):
+    """Regression: _batch_remote used asyncio.run(), which raises
+    RuntimeError from threads that already run a loop (the async rollout
+    path). With an unreachable service it must fall back to local grading —
+    from sync AND async contexts."""
+    from areal_tpu.rewards import client as rclient
+
+    monkeypatch.setenv(rclient.SERVICE_ENV, "127.0.0.1:9")
+    tasks = [{"task": "math", "generated": "\\boxed{4}",
+              "solutions": ["4"]}] * 2
+
+    sync_scores = rclient.batch_reward(tasks, max_retries=0)
+    assert len(sync_scores) == 2
+
+    async def inside_loop():
+        return rclient.batch_reward(tasks, max_retries=0)
+
+    async_scores = asyncio.run(inside_loop())
+    assert async_scores == sync_scores
