@@ -34,6 +34,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 from areal_tpu.api.train_config import (  # noqa: F401
     ExperimentSaveEvalControl,
     OptimizerConfig,
+    WeightSyncConfig,
 )
 
 
@@ -188,6 +189,11 @@ class BaseExperimentConfig:
     auto_eval: bool = False
     auto_eval_config: AutomaticEvaluatorConfig = dataclasses.field(
         default_factory=AutomaticEvaluatorConfig
+    )
+    # Trainer→generation-fleet weight transport (docs/weight_sync.md):
+    # `weight_sync.transport=disk` falls back to the checkpoint round-trip.
+    weight_sync: WeightSyncConfig = dataclasses.field(
+        default_factory=WeightSyncConfig
     )
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10

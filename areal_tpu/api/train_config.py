@@ -31,12 +31,29 @@ class OptimizerConfig:
     lr_scheduler_type: str = "constant"  # constant | cosine | linear
     gradient_clipping: float = 1.0
     # Adam moment storage dtypes (master params are always f32). bf16
-    # moments halve optimizer HBM: mu is a smoothed gradient (fits bf16's
-    # range; the update math still runs in f32), nu in bf16 adds ~0.4%
-    # relative noise to the adaptive scale. Defaults keep nu exact; HBM-
-    # constrained configs (bench.py on a 16G chip) set nu_dtype=bfloat16.
-    mu_dtype: Optional[str] = "bfloat16"
+    # moments halve optimizer HBM (the update math still runs in f32 per
+    # step), but a bf16 default would silently lossy-cast f32 optimizer
+    # states on resume — so BOTH default to exact f32; HBM-constrained
+    # configs (bench.py on a 16G chip) opt into bf16 explicitly.
+    mu_dtype: Optional[str] = "float32"
     nu_dtype: Optional[str] = "float32"
+
+
+@dataclasses.dataclass
+class WeightSyncConfig:
+    """Trainer→generation-fleet weight transport (docs/weight_sync.md).
+
+    ``stream`` publishes per-tensor chunks over ZMQ straight from the
+    trainer's host cache (system/weight_stream.py) — no checkpoint
+    round-trip through the filesystem; ``disk`` is the legacy fallback
+    (native-pytree checkpoint under the realloc dir)."""
+
+    transport: str = "stream"  # stream | disk
+    # Wire chunk size (MB) for the streamed transport; smaller chunks
+    # pipeline finer, larger chunks amortize framing.
+    chunk_mb: int = 32
+    # In-flight chunk requests per consuming server.
+    pipeline_depth: int = 4
 
 
 @dataclasses.dataclass

@@ -85,6 +85,10 @@ class AutomaticEvaluator:
         self.poll_secs = poll_secs
         self.mock_tokenizer = mock_tokenizer
         self._run_eval = run_eval or self._subprocess_eval
+        # poll_once runs _eval_one on a thread pool; tensorboard's event
+        # writer is not thread-safe, so metric writes are serialized here
+        # (interleaved writes corrupt the event-record framing).
+        self._writer_lock = threading.Lock()
         self._seen: set = set()
         self.steps: List[EvaluationStep] = []
         self._stop = threading.Event()
@@ -131,7 +135,8 @@ class AutomaticEvaluator:
                     if isinstance(v, (int, float))
                 }
                 # MetricWriter API (base/monitor.py:115): write(stats, step)
-                self.writer.write(metrics, step.step)
+                with self._writer_lock:
+                    self.writer.write(metrics, step.step)
             return True
         except Exception as e:  # noqa: BLE001 — eval must not kill training
             step.status = "failed"

@@ -818,8 +818,11 @@ class JaxTrainEngine(TrainableEngine):
             input_, mb_spec, length_bucket=self.length_bucket,
             rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
         )
-        key = id(post_hook)
         use_lp = self._use_chunked_logprobs(post_hook)
+        # use_lp is part of the key: id() of a GC'd hook can be reused by a
+        # new hook with a different wants_token_logprobs, which would route
+        # through the wrong logprob head via the stale cached jit.
+        key = (id(post_hook), use_lp)
         if key not in self._fwd_fns:
 
             def f(params, batch):

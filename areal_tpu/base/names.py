@@ -59,6 +59,14 @@ def model_version_time(experiment: str, trial: str, role: str) -> str:
     return f"{_base(experiment, trial)}/model_version_time/{role}"
 
 
+def weight_stream(experiment: str, trial: str, role: str) -> str:
+    """ZMQ endpoint of the trainer's WeightStreamPublisher for ``role`` —
+    present iff the trainer publishes weights over the streamed transport
+    (system/weight_stream.py); its absence means consumers fall back to
+    the disk realloc path."""
+    return f"{_base(experiment, trial)}/weight_stream/{role}"
+
+
 def experiment_status(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/exp_status"
 

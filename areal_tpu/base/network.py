@@ -34,6 +34,14 @@ def bind_addr() -> str:
     return "0.0.0.0"
 
 
+def advertised_tcp(port: int) -> str:
+    """``tcp://<routable-ip>:<port>`` — the address peers should CONNECT to
+    for a socket bound on :func:`bind_addr`. Shared by the ZMQ fabric
+    (system/streams.py request/push sockets, system/weight_stream.py
+    publisher) so every advertisement resolves the host the same way."""
+    return f"tcp://{gethostip()}:{port}"
+
+
 def find_free_port(lockfile_root: str | None = None) -> int:
     """Find a free TCP port. When ``lockfile_root`` is given, takes an flock on
     a per-port lockfile so concurrent processes on one host don't race."""

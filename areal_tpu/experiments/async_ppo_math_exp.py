@@ -55,6 +55,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 batch_window_ms=self.gen_batch_window_ms,
                 max_batch_size=self.gen_max_batch_size,
                 prompt_bucket=self.gen_prompt_bucket,
+                weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
             )
             for i in range(n_gen)
         ]

@@ -320,6 +320,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             tokenizer=None,  # resolved in-process by the launcher entry
             stream_dataset=async_mode,
             realloc_dir=paths["realloc"],
+            weight_sync=self.weight_sync,
         )
 
     def build_master_config(self, async_mode: bool = False):

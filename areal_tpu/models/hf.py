@@ -576,22 +576,27 @@ def save_hf_checkpoint(
         )
 
 
-def _flatten_pytree(params) -> Dict[str, np.ndarray]:
-    """Nested-dict param pytree → flat {path: leaf} with '/'-joined keys."""
-    out: Dict[str, np.ndarray] = {}
+def flatten_pytree(params, as_numpy: bool = False) -> Dict[str, Any]:
+    """Nested-dict param pytree → flat {path: leaf} with '/'-joined keys.
+
+    ``as_numpy=False`` keeps leaves verbatim (device arrays stay on
+    device) — the weight-stream publisher/consumer use this so flattening
+    a live tree never forces a d2h transfer; ``as_numpy=True`` converts
+    for host serialization (checkpoint writers)."""
+    out: Dict[str, Any] = {}
 
     def walk(prefix, node):
         if isinstance(node, dict):
             for k, v in node.items():
                 walk(f"{prefix}/{k}" if prefix else str(k), v)
         else:
-            out[prefix] = np.asarray(node)
+            out[prefix] = np.asarray(node) if as_numpy else node
 
     walk("", params)
     return out
 
 
-def _unflatten_pytree(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+def unflatten_pytree(flat: Dict[str, Any]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     for path, v in flat.items():
         parts = path.split("/")
@@ -600,6 +605,14 @@ def _unflatten_pytree(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
             d = d.setdefault(p, {})
         d[parts[-1]] = v
     return out
+
+
+# Back-compat aliases (pre-stream-sync private names).
+def _flatten_pytree(params) -> Dict[str, np.ndarray]:
+    return flatten_pytree(params, as_numpy=True)
+
+
+_unflatten_pytree = unflatten_pytree
 
 
 def save_native_checkpoint(

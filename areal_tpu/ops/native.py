@@ -103,6 +103,38 @@ def _p(arr: np.ndarray, typ):
     return arr.ctypes.data_as(typ)
 
 
+def _check_intervals(rows, cols, lens, offs, n_rows: int, n_cols: int,
+                     flat_total: int) -> None:
+    """Validate every interval against the [R, L] grid and the flat buffer
+    BEFORE handing pointers to the C memcpy loop. The NumPy fallback would
+    raise an IndexError on the same inputs; the raw C path would silently
+    corrupt memory instead — so mirror the fallback and raise."""
+    if len(rows) == 0:
+        return
+    if not (len(rows) == len(cols) == len(lens) == len(offs)):
+        raise ValueError(
+            f"interval arrays disagree on length: rows={len(rows)} "
+            f"cols={len(cols)} lens={len(lens)} offs={len(offs)}"
+        )
+    if int(lens.min()) < 0 or int(cols.min()) < 0 or int(offs.min()) < 0:
+        raise ValueError("negative interval length/column/offset")
+    if int(rows.min()) < 0 or int(rows.max()) >= n_rows:
+        raise ValueError(
+            f"row index out of range [0, {n_rows}): "
+            f"[{rows.min()}, {rows.max()}]"
+        )
+    if int((cols + lens).max()) > n_cols:
+        raise ValueError(
+            f"interval exceeds grid width {n_cols}: "
+            f"max col+len {(cols + lens).max()}"
+        )
+    if int((offs + lens).max()) > flat_total:
+        raise ValueError(
+            f"interval exceeds flat buffer size {flat_total}: "
+            f"max off+len {(offs + lens).max()}"
+        )
+
+
 def scatter_intervals(
     packed: np.ndarray,  # [total] contiguous (1-D per-token key)
     out: np.ndarray,  # [R, L] contiguous, pre-filled
@@ -115,6 +147,8 @@ def scatter_intervals(
     if lib is None or out.ndim != 2 or packed.ndim != 1:
         return False
     rows, cols, lens, offs = map(_i64, (rows, cols, lens, offs))
+    _check_intervals(rows, cols, lens, offs, out.shape[0], out.shape[1],
+                     packed.shape[0])
     U8P = ctypes.POINTER(ctypes.c_uint8)
     I64P = ctypes.POINTER(ctypes.c_int64)
     lib.scatter_intervals(
@@ -131,9 +165,11 @@ def gather_intervals(
     rows, cols, lens, offs,
 ) -> bool:
     lib = _load()
-    if lib is None:
+    if lib is None or grid.ndim != 2 or out.ndim != 1:
         return False
     rows, cols, lens, offs = map(_i64, (rows, cols, lens, offs))
+    _check_intervals(rows, cols, lens, offs, grid.shape[0], grid.shape[1],
+                     out.shape[0])
     U8P = ctypes.POINTER(ctypes.c_uint8)
     I64P = ctypes.POINTER(ctypes.c_int64)
     lib.gather_intervals(

@@ -17,7 +17,9 @@ update from disk). TPU-first design differences:
    bucketed prompt lengths (prefix re-prefill per chunk; a paged KV cache
    across chunks is a later optimization).
  - ``/update_weights`` hot-swaps params in place (device_put over the old
-   sharding) from the trainer's published checkpoint (§3.5 disk path).
+   sharding) from the trainer's publish — either streamed per-tensor over
+   ZMQ (§3.5 low-latency path, system/weight_stream.py) or read from the
+   published checkpoint (disk fallback).
 
 Endpoints: POST /generate, POST /update_weights, GET /health, GET /metrics.
 """
@@ -61,6 +63,9 @@ class GenerationServerConfig:
     # before kv_slots states (advisor r2, medium). LRU-evicted states simply
     # re-prefill on their next chunk.
     kv_bytes_budget: int = 4 << 30
+    # In-flight chunk requests when consuming a streamed weight update
+    # (weight_sync.pipeline_depth threaded through the experiment config).
+    weight_stream_pipeline_depth: int = 4
 
 
 class _Pending:
@@ -313,10 +318,91 @@ class GenerationServer:
             params,
         )
 
+    def _stream_and_put_weights(self, endpoint: str, version: int,
+                                timeout_secs: Optional[float] = None):
+        """Streamed transport (docs/weight_sync.md): pull the manifest +
+        per-tensor chunks from the trainer's WeightStreamPublisher into a
+        SHADOW pytree, device_put'ing each tensor as it lands so the h2d
+        upload of tensor i−1 overlaps the wire transfer of tensor i (whose
+        d2h gather the publisher is doing concurrently). The shadow tree
+        only replaces ``self.params`` after the publisher's digest verifies
+        the complete stream — a torn, reordered, or corrupted transfer
+        raises before anything live is touched."""
+        import jax
+
+        from areal_tpu.models.hf import flatten_pytree, unflatten_pytree
+        from areal_tpu.system.weight_stream import (
+            WeightStreamConsumer,
+            WeightStreamError,
+        )
+
+        old_flat = flatten_pytree(self.params)
+        consumer = WeightStreamConsumer(
+            endpoint,
+            pipeline_depth=self.cfg.weight_stream_pipeline_depth,
+            **({} if timeout_secs is None
+               else {"timeout_secs": timeout_secs}),
+        )
+        try:
+            manifest = consumer.fetch_manifest(version)
+            shadow = {}
+            for name, arr in consumer.iter_tensors(version, manifest):
+                old = old_flat.get(name)
+                if old is None:
+                    raise WeightStreamError(
+                        f"streamed tensor {name!r} not in the live pytree"
+                    )
+                if tuple(arr.shape) != tuple(old.shape):
+                    raise WeightStreamError(
+                        f"tensor {name!r}: streamed shape {arr.shape} != "
+                        f"live {old.shape}"
+                    )
+                # Async dispatch: device_put returns immediately, so the
+                # upload runs while the next chunks arrive.
+                shadow[name] = jax.device_put(
+                    np.asarray(arr, dtype=old.dtype), old.sharding
+                )
+            if set(shadow) != set(old_flat):
+                missing = sorted(set(old_flat) - set(shadow))
+                raise WeightStreamError(
+                    f"incomplete stream: {len(missing)} tensors missing "
+                    f"(e.g. {missing[:3]})"
+                )
+            # The gate: no swap without a checksum-verified manifest.
+            consumer.verify_digest(version)
+            new = unflatten_pytree(shadow)
+            jax.block_until_ready(new)
+            return new
+        finally:
+            consumer.close()
+
     async def handle_update_weights(self, request):
+        from aiohttp import web
+
         d = await request.json()
         t0 = time.monotonic()
-        new = await asyncio.to_thread(self._load_and_put_weights, d["path"])
+        try:
+            if d.get("endpoint"):
+                new = await asyncio.to_thread(
+                    self._stream_and_put_weights, d["endpoint"],
+                    int(d["version"]),
+                    d.get("timeout"),
+                )
+            else:
+                new = await asyncio.to_thread(
+                    self._load_and_put_weights, d["path"]
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — keep old weights, report
+            # Old (params, version) stay live and /metrics unchanged; the
+            # manager's fanout retry/eviction machinery owns what happens
+            # to this server next (docs/fault_tolerance.md).
+            logger.error(f"weight update failed; keeping v{self.version}: {e}")
+            return web.json_response(
+                {"ok": False, "version": self.version, "error": str(e)},
+                status=500,
+            )
         # Atomic (params, version) swap: in-flight _decode_batch threads
         # captured the old pair and tag their tokens with the old version.
         self.params = new
@@ -328,8 +414,6 @@ class GenerationServer:
         dt = time.monotonic() - t0
         self._last_update_latency = dt
         logger.info(f"weights updated to v{self.version} in {dt:.2f}s")
-        from aiohttp import web
-
         return web.json_response({"ok": True, "version": self.version,
                                   "latency_s": dt})
 

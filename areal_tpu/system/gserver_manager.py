@@ -6,7 +6,11 @@ rollout controller: HTTP router over the generation-server fleet
 (round-robin / least-requests), the **staleness gate** that blocks new
 rollouts when they would be too off-policy, ``/finish_rollout`` accounting,
 and the weight-update fanout (watch ``names.model_version``, POST
-``/update_weights`` to every server, GC old realloc dirs).
+``/update_weights`` to every server, GC old realloc dirs). The fanout
+payload is transport-aware: when the trainer publishes over the streamed
+transport (system/weight_stream.py, discovered via names.weight_stream)
+servers pull per-tensor chunks from the trainer's host cache; otherwise
+they read the realloc-dir checkpoint (disk fallback).
 
 Staleness rule (reference ``is_staled`` :351):
     expected_version = (trained_samples + running) // train_batch_size
@@ -161,6 +165,23 @@ class GserverManager:
         return os.path.join(
             self.cfg.realloc_dir, self.cfg.model_role, str(self.version)
         )
+
+    def _update_payload(self, v: int, path: str) -> Dict:
+        """The /update_weights request body for version ``v``. Transport is
+        auto-detected per push: a trainer publishing over the streamed
+        transport registers its WeightStreamPublisher endpoint under
+        names.weight_stream — servers then pull chunks from the trainer's
+        host cache; otherwise the legacy disk payload points at the
+        realloc checkpoint (docs/weight_sync.md)."""
+        try:
+            endpoint = name_resolve.get(names.weight_stream(
+                self.cfg.experiment, self.cfg.trial, self.cfg.model_role
+            ))
+        except Exception:  # noqa: BLE001 — no stream publisher: disk mode
+            endpoint = None
+        if endpoint:
+            return {"endpoint": endpoint, "version": v}
+        return {"path": path, "version": v}
 
     async def _reconcile_weights(self, sess, url: str,
                                  server_version: int) -> bool:
@@ -471,15 +492,18 @@ class GserverManager:
     # ---------------- weight-update fanout ----------------
 
     async def _push_weights_one(self, sess, url: str, v: int,
-                                path: str) -> bool:
+                                path: str,
+                                payload: Optional[Dict] = None) -> bool:
         """POST /update_weights to one server, bounded by the per-server
         timeout and retried per ``fanout_retry``. Returns ack success."""
+        if payload is None:
+            payload = self._update_payload(v, path)
 
         async def _post():
             if self.faults is not None:
                 self.faults.maybe_fail("fanout", url=url, version=v)
             async with sess.post(
-                f"{url}/update_weights", json={"path": path, "version": v}
+                f"{url}/update_weights", json=payload
             ) as r:
                 if r.status != 200:
                     raise RuntimeError(f"/update_weights status {r.status}")
@@ -510,8 +534,13 @@ class GserverManager:
         exhausts its retry budget is EVICTED (never silently left serving
         stale weights behind a bumped version). Returns the acked urls."""
         targets = list(self.servers)
+        # One payload for the whole fanout: every server must receive the
+        # SAME transport for version v (a mid-fanout trainer restart could
+        # otherwise hand half the fleet a stream endpoint and half a path).
+        payload = self._update_payload(v, path)
         results = await asyncio.gather(*[
-            self._push_weights_one(sess, u, v, path) for u in targets
+            self._push_weights_one(sess, u, v, path, payload=payload)
+            for u in targets
         ])
         acked = [u for u, ok in zip(targets, results) if ok]
         if not acked:

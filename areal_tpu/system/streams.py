@@ -123,10 +123,10 @@ class WorkerRequestServer:
         self.handler = handler
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
-        host = network.gethostip()
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
         self._key = req_reply_addr_key(experiment, trial, handler)
-        name_resolve.add(self._key, f"tcp://{host}:{port}", replace=True)
+        name_resolve.add(self._key, network.advertised_tcp(port),
+                         replace=True)
         self._peer_of: Dict[str, bytes] = {}
 
     def poll(self, timeout_ms: int = 0) -> Optional[Payload]:
@@ -191,11 +191,10 @@ class ZmqPuller:
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.PULL)
         self._sock.setsockopt(zmq.RCVHWM, capacity)
-        host = network.gethostip()
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
         name_resolve.add(
             push_pull_addr_key(experiment, trial, name),
-            f"tcp://{host}:{port}", replace=True,
+            network.advertised_tcp(port), replace=True,
         )
 
     def pull(self, timeout_ms: int = 0) -> Optional[Any]:

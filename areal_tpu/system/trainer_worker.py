@@ -38,6 +38,7 @@ from areal_tpu.api.model import (
     make_dataset,
     make_interface,
 )
+from areal_tpu.api.train_config import WeightSyncConfig
 from areal_tpu.base import logging, name_resolve, names
 from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
 
@@ -80,6 +81,13 @@ class TrainerWorkerConfig:
     # async mode: pull trajectories from rollout workers instead of a dataset
     stream_dataset: bool = False
     realloc_dir: str = "/tmp/areal_tpu/realloc"
+    # Weight publish transport. The worker-level default stays "disk" for
+    # back-compat with directly constructed configs; the experiment config
+    # tree (api.cli_args BaseExperimentConfig.weight_sync) defaults to the
+    # streamed transport and threads it through here.
+    weight_sync: WeightSyncConfig = dataclasses.field(
+        default_factory=lambda: WeightSyncConfig(transport="disk")
+    )
     # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
     # one per host — join one jax.distributed program; rank 0 owns every
     # control-plane socket and broadcasts (request, data) to the others,
@@ -112,6 +120,7 @@ class TrainerWorker:
         self._pull_thread = None
         self._model_factory = model_factory or self._default_model_factory
         self._exiting = False
+        self._weight_publishers: Dict[str, Any] = {}  # role -> publisher
 
     # ---------------- setup ----------------
 
@@ -345,27 +354,13 @@ class TrainerWorker:
             raise ValueError(f"unknown hook {hook}")
 
     def _save_role(self, role: str, path: str, fmt: str = "hf") -> None:
-        import jax
-        import jax.numpy as jnp
-
         from areal_tpu.models import hf as hfmod
         from areal_tpu.parallel import distributed as dist
 
         model = self.models[role]
         engine = model.module
-        params = engine.params
-        if fmt == "native":
-            # Weight-sync payloads travel in the COMPUTE dtype (bf16): the
-            # generation fleet computes in bf16 anyway, and casting on
-            # device before the gather halves d2h + disk + h2d bytes vs
-            # shipping the f32 masters.
-            cd = getattr(engine, "compute_dtype", jnp.float32)
-            if cd != jnp.float32:
-                params = jax.tree.map(
-                    lambda x: x.astype(cd)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                    params,
-                )
+        params = (self._compute_dtype_params(role) if fmt == "native"
+                  else engine.params)
         host_params = dist.allgather_params(params)
         if not self._rank0:
             return
@@ -376,12 +371,39 @@ class TrainerWorker:
             meta={"version": model.version.global_step},
         )
 
+    def _compute_dtype_params(self, role: str):
+        """The role's params cast (on device) to the compute dtype —
+        weight-sync payloads travel in bf16: the generation fleet computes
+        in bf16 anyway, and casting before the d2h halves transport bytes
+        vs shipping the f32 masters."""
+        import jax
+        import jax.numpy as jnp
+
+        engine = self.models[role].module
+        params = engine.params
+        cd = getattr(engine, "compute_dtype", jnp.float32)
+        if cd != jnp.float32:
+            params = jax.tree.map(
+                lambda x: x.astype(cd)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
+            )
+        return params
+
     def publish_weights(self, role: str) -> None:
-        """The §3.5 weight-sync path: save weights under the realloc dir
-        and bump names.model_version. Uses the NATIVE pytree format
-        (models/hf.py save_native_checkpoint) — the generation server is
-        in-house, so the per-version publish skips the HF layout
-        conversion both ways; persistent saves ("save" hooks) stay HF."""
+        """The §3.5 weight-sync path: make the role's weights visible to
+        the generation fleet and bump names.model_version.
+
+        Transport "stream" (docs/weight_sync.md) hands the tensors to a
+        per-role WeightStreamPublisher: servers pull per-tensor chunks
+        over ZMQ straight from this process's host cache — no checkpoint
+        round-trip through the filesystem. Transport "disk" is the legacy
+        fallback: NATIVE pytree format under the realloc dir (models/hf.py
+        save_native_checkpoint — skips HF layout conversion both ways;
+        persistent "save" hooks stay HF)."""
+        if self.cfg.weight_sync.transport == "stream":
+            self._publish_weights_stream(role)
+            return
         model = self.models[role]
         version = model.version.global_step
         path = os.path.join(self.cfg.realloc_dir, role, str(version))
@@ -390,21 +412,71 @@ class TrainerWorker:
         save_secs = time.monotonic() - t0
         if not self._rank0:
             return
+        # A crashed stream-mode predecessor may have left its endpoint in
+        # name_resolve; clear it so the manager's transport auto-detection
+        # routes this publish (and all later ones) at the disk checkpoint
+        # instead of a dead publisher socket.
+        try:
+            name_resolve.delete(names.weight_stream(
+                self.cfg.experiment, self.cfg.trial, role
+            ))
+        except Exception:  # noqa: BLE001 — normally absent
+            pass
+        self._bump_version(role, version, save_secs)
+        logger.info(
+            f"published {role} weights v{version} -> {path} "
+            f"(save {save_secs:.2f}s)"
+        )
+
+    def _publish_weights_stream(self, role: str) -> None:
+        from areal_tpu.models.hf import flatten_pytree
+
+        model = self.models[role]
+        version = model.version.global_step
+        t0 = time.monotonic()
+        params = self._compute_dtype_params(role)
+        if self.cfg.dist_world > 1:
+            # Multi-host: every rank joins the gather; only rank 0 owns a
+            # publisher, so the others contribute their shards and return.
+            from areal_tpu.parallel import distributed as dist
+
+            params = dist.allgather_params(params)
+        if not self._rank0:
+            return
+        pub = self._weight_publishers.get(role)
+        if pub is None:
+            from areal_tpu.system.weight_stream import WeightStreamPublisher
+
+            pub = WeightStreamPublisher(
+                self.cfg.experiment, self.cfg.trial, role,
+                chunk_bytes=self.cfg.weight_sync.chunk_mb << 20,
+            )
+            self._weight_publishers[role] = pub
+        # publish() returns the moment the manifest is registered: the d2h
+        # gather runs in the publisher's background thread, overlapping the
+        # wire leg of tensors already gathered (and the servers' uploads).
+        pub.publish(sorted(flatten_pytree(params).items()), version)
+        publish_secs = time.monotonic() - t0
+        self._bump_version(role, version, publish_secs)
+        logger.info(
+            f"published {role} weights v{version} -> {pub.endpoint} "
+            f"(stream publish {publish_secs:.2f}s; gather continues in "
+            f"background)"
+        )
+
+    def _bump_version(self, role: str, version: int,
+                      publish_secs: float) -> None:
         # Publish time anchors the end-to-end weight-sync latency metric
-        # (save start → every server swapped; GserverManager reads it).
+        # (publish start → every server swapped; GserverManager reads it).
         name_resolve.add(
             names.model_version_time(
                 self.cfg.experiment, self.cfg.trial, role
             ),
-            repr(time.time() - save_secs), replace=True,
+            repr(time.time() - publish_secs), replace=True,
         )
         name_resolve.add(
             names.model_version(self.cfg.experiment, self.cfg.trial, role),
             str(version), replace=True,
-        )
-        logger.info(
-            f"published {role} weights v{version} -> {path} "
-            f"(save {save_secs:.2f}s)"
         )
 
     def _handle_model_info(self) -> Dict[str, Any]:
@@ -611,3 +683,5 @@ class TrainerWorker:
             self._server.close()
         if self._puller:
             self._puller.close()
+        for pub in self._weight_publishers.values():
+            pub.close()

@@ -110,67 +110,63 @@ def main():
     tokens_per_sec_chip = steps * total / dt / n_chips
 
     # North-star metric #2 (BASELINE.json): trainer→rollout weight-sync
-    # latency. Measured as the full disk path on this chip: NATIVE-format
-    # bf16 safetensors save → load → device_put swap (what
-    # trainer_worker.publish_weights + generation_server /update_weights
-    # do — the native pytree format skips HF-layout transposes both ways).
-    # The breakdown separates what the framework controls (serialize + disk
-    # IO) from raw host<->device transport: on this harness the chip is
-    # remote (axon tunnel, measured ~9 MB/s serialized — 1 GB of bf16 params
-    # takes ~110 s EACH way regardless of software), while on a real v5p
-    # host the same legs ride PCIe at ~10 GB/s (~0.2 s round trip), leaving
-    # the IO number as the true system latency.
-    import shutil
-    import tempfile
+    # latency, measured through the STREAMED transport (the production
+    # path since this round, docs/weight_sync.md): the trainer-side
+    # WeightStreamPublisher gathers bf16 tensors d2h in a background
+    # thread while a consumer (standing in for one generation server)
+    # pulls the chunks over ZMQ and device_puts each tensor as it lands —
+    # the checkpoint round-trip through the filesystem is gone, and BOTH
+    # host↔device legs are measured directly (r05's disk path measured d2h
+    # and extrapolated h2d as symmetric; see docs/benchmarks.md for the
+    # method discontinuity).
+    import jax.numpy as jnp
 
-    from areal_tpu.models import hf as hfmod
-    from areal_tpu.parallel import distributed as dist
+    from areal_tpu.models.hf import flatten_pytree
+    from areal_tpu.system.weight_stream import (
+        WeightStreamConsumer,
+        WeightStreamPublisher,
+    )
 
     eng = model.module
-    sync_dir = tempfile.mkdtemp(prefix="areal_sync_")
+    publisher = None
+    consumer = None
     try:
         t0 = time.perf_counter()
         # Publish in the compute dtype (bf16), cast on device — mirrors
-        # trainer_worker._save_role(fmt="native"): half the d2h/disk/h2d
+        # trainer_worker._publish_weights_stream: half the d2h/wire/h2d
         # bytes vs shipping the f32 masters.
-        import jax.numpy as jnp
-
         pub = jax.tree.map(
             lambda x: x.astype(eng.compute_dtype)
             if jnp.issubdtype(x.dtype, jnp.floating) else x,
             eng.params,
         )
-        host_params = dist.allgather_params(pub)  # d2h (overlapped)
-        t_get = time.perf_counter()
-        hfmod.save_native_checkpoint(host_params, cfg, sync_dir)
-        t_save = time.perf_counter()
-        _, loaded = hfmod.load_native_checkpoint(sync_dir)
-        t_load = time.perf_counter()
-        # The h2d swap leg is EXTRAPOLATED as symmetric with the measured
-        # d2h leg rather than measured: both ride the same tunnel whose
-        # ~minutes-per-GB bandwidth varies run to run, and measuring it
-        # twice only doubles harness wall-clock on a number that is pure
-        # environment (on a real v5p host both legs are sub-second PCIe).
-        # Full-tree HOST-side round-trip validation (regression guard the
-        # removed full device_put used to provide): structure, shapes and
-        # dtypes of the reloaded checkpoint must match the engine's tree.
-        def _check_leaf(old, npv):
-            a = np.asarray(npv)
-            assert a.shape == old.shape and a.dtype == old.dtype, (
-                f"sync round-trip mismatch: {a.shape}/{a.dtype} vs "
-                f"{old.shape}/{old.dtype}"
+        old_flat = flatten_pytree(pub)  # device refs, no transfer
+        publisher = WeightStreamPublisher("bench", "b0", "actor")
+        publisher.publish(sorted(old_flat.items()), version=1)
+        consumer = WeightStreamConsumer(publisher.endpoint)
+        manifest = consumer.fetch_manifest(1)
+        shadow = {}
+        for name, arr in consumer.iter_tensors(1, manifest):
+            old = old_flat[name]
+            # Async dispatch: h2d of tensor i−1 overlaps the wire transfer
+            # of tensor i and the publisher's d2h gather of tensor i+1.
+            shadow[name] = jax.device_put(
+                np.asarray(arr, dtype=old.dtype), old.sharding
             )
-
-        jax.tree.map(_check_leaf, pub, loaded)
-        # One-leaf device_put sanity-checks the h2d path itself.
-        leaf = jax.tree.leaves(loaded)[0]
-        jax.block_until_ready(jax.device_put(np.asarray(leaf)))
-        d2h = t_get - t0
-        weight_sync_transport_s = 2 * d2h
-        weight_sync_io_s = (t_save - t_get) + (t_load - t_save)
-        weight_sync_s = weight_sync_io_s + weight_sync_transport_s
+        consumer.verify_digest(1)
+        assert set(shadow) == set(old_flat)
+        jax.block_until_ready(list(shadow.values()))
+        weight_sync_s = time.perf_counter() - t0
+        # "io" = the host-side CPU work the framework controls (checksums,
+        # framing, reassembly) — the analogue of r05's serialize+disk leg;
+        # everything else is d2h/wire/h2d transport, pipelined.
+        weight_sync_io_s = consumer.checksum_secs
+        weight_sync_transport_s = weight_sync_s - weight_sync_io_s
     finally:
-        shutil.rmtree(sync_dir, ignore_errors=True)
+        if consumer is not None:
+            consumer.close()
+        if publisher is not None:
+            publisher.close()
 
     # Roofline context: analytic train FLOPs (6·N·T, llama formula family —
     # reference realhf/base/monitor.py:288) over the bf16 peak of one chip.
@@ -192,11 +188,11 @@ def main():
         "weight_sync_latency_s": round(weight_sync_s, 3),
         "weight_sync_io_s": round(weight_sync_io_s, 3),
         "weight_sync_transport_s": round(weight_sync_transport_s, 3),
-        # METHOD CHANGE vs r4: transport is io-measured d2h × 2 (symmetric
-        # extrapolation); earlier rounds timed both tunnel legs directly.
-        # Not comparable run-to-run anyway (tunnel bandwidth varies 5x);
-        # on-host PCIe makes both legs sub-second on real v5p.
-        "weight_sync_transport_method": "2x-d2h-extrapolated",
+        # METHOD CHANGE vs r5: the streamed transport is measured end to
+        # end — d2h gather, wire, AND h2d upload, pipelined — with no disk
+        # round-trip (r5 measured disk io + d2h and extrapolated h2d as
+        # 2× d2h). See docs/benchmarks.md for the discontinuity note.
+        "weight_sync_transport_method": "streamed-measured",
     }))
 
 

@@ -83,6 +83,32 @@ def test_ffd_assign_matches_python():
         assert got == ref
 
 
+@needs_native
+def test_scatter_gather_bounds_checked():
+    """Out-of-range intervals must raise BEFORE the C memcpy runs (the
+    NumPy fallback would raise on the same inputs; the raw pointer loop
+    would corrupt memory instead)."""
+    packed = np.arange(16, dtype=np.int32)
+    out = np.zeros((2, 8), np.int32)
+    ok = dict(rows=[0], cols=[0], lens=[8], offs=[0])
+    assert native.scatter_intervals(packed, out, **ok)
+    for bad in (
+        dict(ok, rows=[2]),          # row ≥ R
+        dict(ok, rows=[-1]),         # negative row
+        dict(ok, cols=[4]),          # col+len > L
+        dict(ok, lens=[-2]),         # negative length
+        dict(ok, offs=[12]),         # off+len > packed size
+    ):
+        with pytest.raises(ValueError):
+            native.scatter_intervals(packed, out, **{
+                k: np.asarray(v) for k, v in bad.items()
+            })
+        with pytest.raises(ValueError):
+            native.gather_intervals(out, packed.copy(), **{
+                k: np.asarray(v) for k, v in bad.items()
+            })
+
+
 def test_batch_from_packed_uses_native_and_matches():
     """The packer's grid scatter must produce identical grids whether or
     not the native path engaged (it silently falls back without g++)."""
