@@ -34,6 +34,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 from areal_tpu.api.train_config import (  # noqa: F401
     ExperimentSaveEvalControl,
     OptimizerConfig,
+    TelemetryConfig,
     WeightSyncConfig,
 )
 
@@ -194,6 +195,12 @@ class BaseExperimentConfig:
     # `weight_sync.transport=disk` falls back to the checkpoint round-trip.
     weight_sync: WeightSyncConfig = dataclasses.field(
         default_factory=WeightSyncConfig
+    )
+    # Unified telemetry layer (docs/observability.md): off by default —
+    # `telemetry.enabled=true` turns on cross-worker metric aggregation,
+    # rollout trace spans, Prometheus /metrics, and profiler triggers.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
     )
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10

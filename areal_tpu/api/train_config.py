@@ -57,6 +57,27 @@ class WeightSyncConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Unified telemetry layer (base/telemetry.py, docs/observability.md).
+
+    Off by default: with ``enabled=False`` every instrumented call site
+    routes to a shared no-op sink — no ZMQ sockets, no HTTP servers, no
+    span allocation — so the hot paths carry no passive overhead."""
+
+    enabled: bool = False
+    # Worker→aggregator snapshot push cadence.
+    flush_interval_secs: float = 2.0
+    # Aggregated per-snapshot stream; defaults under the experiment log
+    # dir (<log>/telemetry.jsonl) when the experiment tree wires it.
+    jsonl_path: Optional[str] = None
+    # >0: the master's aggregator serves the merged fleet state as
+    # Prometheus text on this plain-HTTP port (GET /metrics).
+    http_port: int = 0
+    # Span buffer bound per process between flushes (oldest drop first).
+    max_buffered_spans: int = 4096
+
+
+@dataclasses.dataclass
 class ExperimentSaveEvalControl:
     """Reference cli_args.py:702."""
 

@@ -36,7 +36,7 @@ from areal_tpu.api.model import (
     register_backend,
 )
 from areal_tpu.backend import microbatch as mbu
-from areal_tpu.base import logging
+from areal_tpu.base import logging, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer
 from areal_tpu.models.config import TransformerConfig
@@ -444,10 +444,11 @@ class JaxTrainEngine(TrainableEngine):
     def upload_uniform(
         self, input_: SequenceSample, mb_spec: MicroBatchSpec
     ) -> "UniformBatch":
-        mbs = mbu.split_into_microbatches(
-            input_, mb_spec, length_bucket=self.length_bucket,
-            rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
-        )
+        with telemetry.span("train/split_pack"):
+            mbs = mbu.split_into_microbatches(
+                input_, mb_spec, length_bucket=self.length_bucket,
+                rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+            )
         R, L = mbs[0].layout.shape
         S = max(len(mb.seq_mask) for mb in mbs)
         S = mbu.packing.round_up(S, self.seqs_bucket)
@@ -582,34 +583,39 @@ class JaxTrainEngine(TrainableEngine):
         scale = 1.0 if glob else 1.0 / len(idxs)
         aux_scale = (1.0 / len(idxs)) if glob else 1.0
         carry = None
-        for i, w in zip(idxs, weights):
-            denom = total_w if glob else w
-            fn = self._get_sliced_grad_fn(
-                loss_fn, with_carry=carry is not None, R=ub.R
-            )
-            args = [
-                self.params, ub.grids, ub.seq, jnp.asarray(i, jnp.int32),
-                jnp.asarray(denom, jnp.float32),
-                jnp.asarray(scale, jnp.float32),
-                jnp.asarray(aux_scale, jnp.float32),
-            ]
-            if carry is not None:
-                args.append(carry)
-            with self._mesh_ctx():
-                carry = fn(*args)
+        with telemetry.span("train/fwd_bwd", n_mbs=len(idxs)):
+            for i, w in zip(idxs, weights):
+                denom = total_w if glob else w
+                fn = self._get_sliced_grad_fn(
+                    loss_fn, with_carry=carry is not None, R=ub.R
+                )
+                args = [
+                    self.params, ub.grids, ub.seq, jnp.asarray(i, jnp.int32),
+                    jnp.asarray(denom, jnp.float32),
+                    jnp.asarray(scale, jnp.float32),
+                    jnp.asarray(aux_scale, jnp.float32),
+                ]
+                if carry is not None:
+                    args.append(carry)
+                with self._mesh_ctx():
+                    carry = fn(*args)
+            if telemetry.enabled():
+                # Honest fwd-bwd/optimizer split; without telemetry this
+                # sync does not exist (one-host-sync-per-step contract).
+                jax.block_until_ready(carry)
         loss_acc, stats_acc, grads_acc = carry
-        with self._mesh_ctx():
-            self.params, self.opt_state, gnorm, applied = self._get_apply_fn(
-                rule
-            )(
-                self.params, self.opt_state, grads_acc, dict(stats_acc),
-                jnp.asarray(cap, jnp.float32),
-            )
-        applied_lr = float(self.lr_schedule(self.opt_step_count))
-        fetched = jax.device_get({
-            **stats_acc, **(extra_fetch or {}), "loss": loss_acc,
-            "grad_norm": gnorm, "update_applied": applied,
-        })
+        with telemetry.span("train/optimizer"):
+            with self._mesh_ctx():
+                self.params, self.opt_state, gnorm, applied = \
+                    self._get_apply_fn(rule)(
+                        self.params, self.opt_state, grads_acc,
+                        dict(stats_acc), jnp.asarray(cap, jnp.float32),
+                    )
+            applied_lr = float(self.lr_schedule(self.opt_step_count))
+            fetched = jax.device_get({
+                **stats_acc, **(extra_fetch or {}), "loss": loss_acc,
+                "grad_norm": gnorm, "update_applied": applied,
+            })
         if bool(fetched["update_applied"]):
             self.opt_step_count += 1
         out = {k: float(v) for k, v in fetched.items()}
@@ -619,6 +625,9 @@ class JaxTrainEngine(TrainableEngine):
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(ub.mbs[i].n_tokens for i in idxs))
         out["loss_weight"] = total_w
+        telemetry.inc("train/tokens", out["total_tokens"])
+        telemetry.inc("train/optimizer_steps",
+                      1.0 if bool(fetched["update_applied"]) else 0.0)
         return out
 
     def _device_batch(self, mb: mbu.MicroBatch) -> Dict[str, jnp.ndarray]:
@@ -657,10 +666,11 @@ class JaxTrainEngine(TrainableEngine):
         early-stop checks the importance ratio BEFORE stepping). The
         returned stats carry ``update_applied`` ∈ {0.0, 1.0}."""
         assert self.tx is not None, "engine built without an optimizer"
-        mbs = mbu.split_into_microbatches(
-            input_, mb_spec, length_bucket=self.length_bucket,
-            rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
-        )
+        with telemetry.span("train/split_pack"):
+            mbs = mbu.split_into_microbatches(
+                input_, mb_spec, length_bucket=self.length_bucket,
+                rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+            )
         weights = [float(loss_weight_fn(mb)) for mb in mbs]
         total_w = sum(weights)
         rule = None
@@ -674,36 +684,43 @@ class JaxTrainEngine(TrainableEngine):
         scale = 1.0 if glob else 1.0 / n_mbs
         aux_scale = (1.0 / n_mbs) if glob else 1.0
         carry = None
-        for mb, w in zip(mbs, weights):
-            denom = total_w if glob else w
-            batch = self._device_batch(mb)
-            grad_fn = self._get_grad_fn(loss_fn, with_carry=carry is not None)
-            args = [
-                self.params, batch, jnp.asarray(denom, jnp.float32),
-                jnp.asarray(scale, jnp.float32),
-                jnp.asarray(aux_scale, jnp.float32),
-            ]
-            if carry is not None:
-                args.append(carry)
-            with self._mesh_ctx():
-                carry = grad_fn(*args)
+        with telemetry.span("train/fwd_bwd", n_mbs=n_mbs):
+            for mb, w in zip(mbs, weights):
+                denom = total_w if glob else w
+                batch = self._device_batch(mb)
+                grad_fn = self._get_grad_fn(loss_fn,
+                                            with_carry=carry is not None)
+                args = [
+                    self.params, batch, jnp.asarray(denom, jnp.float32),
+                    jnp.asarray(scale, jnp.float32),
+                    jnp.asarray(aux_scale, jnp.float32),
+                ]
+                if carry is not None:
+                    args.append(carry)
+                with self._mesh_ctx():
+                    carry = grad_fn(*args)
+            if telemetry.enabled():
+                # Drain the async dispatch so the fwd-bwd/optimizer split is
+                # honest; without telemetry nothing syncs here (no passive
+                # overhead on the hot path).
+                jax.block_until_ready(carry)
         loss_acc, stats_acc, grads_acc = carry
 
-        with self._mesh_ctx():
-            self.params, self.opt_state, gnorm, applied = self._get_apply_fn(
-                rule
-            )(
-                self.params, self.opt_state, grads_acc, dict(stats_acc),
-                jnp.asarray(cap, jnp.float32),
-            )
-        # optax evaluated the schedule at the PRE-increment count.
-        applied_lr = float(self.lr_schedule(self.opt_step_count))
-        # ONE host round trip for all scalars (each float() would be a
-        # separate device→host sync — expensive through the tunnel).
-        fetched = jax.device_get({
-            **stats_acc, "loss": loss_acc, "grad_norm": gnorm,
-            "update_applied": applied,
-        })
+        with telemetry.span("train/optimizer"):
+            with self._mesh_ctx():
+                self.params, self.opt_state, gnorm, applied = \
+                    self._get_apply_fn(rule)(
+                        self.params, self.opt_state, grads_acc,
+                        dict(stats_acc), jnp.asarray(cap, jnp.float32),
+                    )
+            # optax evaluated the schedule at the PRE-increment count.
+            applied_lr = float(self.lr_schedule(self.opt_step_count))
+            # ONE host round trip for all scalars (each float() would be a
+            # separate device→host sync — expensive through the tunnel).
+            fetched = jax.device_get({
+                **stats_acc, "loss": loss_acc, "grad_norm": gnorm,
+                "update_applied": applied,
+            })
         # A skipped (early-stopped) update must not advance the LR schedule:
         # optax's internal count is an array leaf and was reverted by the
         # gate; keep the host-side mirror in lockstep (reference
@@ -720,6 +737,9 @@ class JaxTrainEngine(TrainableEngine):
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(mb.n_tokens for mb in mbs))
         out["loss_weight"] = total_w
+        telemetry.inc("train/tokens", out["total_tokens"])
+        telemetry.inc("train/optimizer_steps",
+                      1.0 if bool(fetched["update_applied"]) else 0.0)
         return out
 
     # -------------- train-state checkpointing --------------

@@ -94,6 +94,13 @@ class MetricWriter:
 
     def __init__(self, tensorboard_path: Optional[str] = None,
                  wandb_mode: str = "disabled", wandb_kwargs=None):
+        import threading
+
+        # The telemetry aggregator's ingest thread mirrors worker scalars
+        # into the same writer the master loop uses — SummaryWriter is not
+        # thread-safe, so writes serialize (same fix class as the PR 3
+        # evaluator writer lock).
+        self._lock = threading.Lock()
         self._tb = None
         self._wandb = None
         if tensorboard_path:
@@ -113,13 +120,16 @@ class MetricWriter:
                 pass
 
     def write(self, stats: Dict[str, float], step: int) -> None:
-        if self._tb is not None:
-            for k, v in stats.items():
-                self._tb.add_scalar(k, v, step)
-            self._tb.flush()
-        if self._wandb is not None:  # pragma: no cover
-            self._wandb.log(stats, step=step)
+        with self._lock:
+            if self._tb is not None:
+                for k, v in stats.items():
+                    self._tb.add_scalar(k, v, step)
+                self._tb.flush()
+            if self._wandb is not None:  # pragma: no cover
+                self._wandb.log(stats, step=step)
 
     def close(self) -> None:
-        if self._tb is not None:
-            self._tb.close()
+        with self._lock:
+            if self._tb is not None:
+                self._tb.close()
+                self._tb = None

@@ -83,6 +83,24 @@ def used_data_ids(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/used_data"
 
 
+def telemetry_aggregator(experiment: str, trial: str) -> str:
+    """ZMQ PULL endpoint of the master's TelemetryAggregator — workers'
+    TelemetryPushers discover it here (base/telemetry.py)."""
+    return f"{_base(experiment, trial)}/telemetry_aggregator"
+
+
+def profiler_trigger(experiment: str, trial: str) -> str:
+    """On-demand profiler request flag: a JSON {dir, secs} written by an
+    operator (tools/perf_probe.py) and consumed by the trainer's
+    ProfilerTriggerWatcher (base/telemetry.py)."""
+    return f"{_base(experiment, trial)}/profiler_trigger"
+
+
+def profiler_status(experiment: str, trial: str) -> str:
+    """Last profiler-capture outcome published by the trainer."""
+    return f"{_base(experiment, trial)}/profiler_status"
+
+
 def metric_server(experiment: str, trial: str, group: str, index: str) -> str:
     return f"{_base(experiment, trial)}/metrics/{group}/{index}"
 

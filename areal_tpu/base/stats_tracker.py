@@ -11,6 +11,7 @@ can be combined with ``jax.experimental.multihost_utils`` by the caller.
 from __future__ import annotations
 
 import enum
+import threading
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
@@ -39,12 +40,26 @@ class ReduceType(enum.Enum):
 
 
 class StatsTracker:
+    """Workers record from async loops AND health-check/flush threads
+    concurrently (e.g. a telemetry flush exporting while the serve loop
+    appends), so every mutation — scope push/pop included — and the
+    export-with-reset run under one re-entrant lock. Scopes are
+    per-THREAD (a thread-local stack): a background thread's recording
+    must not inherit, or tear, the serve loop's scope nesting."""
+
     def __init__(self):
-        self._scopes: List[str] = []
+        self._local = threading.local()
+        self._lock = threading.RLock()
         self._denoms: Dict[str, np.ndarray] = {}
         # key -> (reduce_type, list of (values, denom_key|None))
         self._stats: Dict[str, tuple] = {}
         self._moving: Dict[str, float] = {}
+
+    @property
+    def _scopes(self) -> List[str]:
+        if not hasattr(self._local, "scopes"):
+            self._local.scopes = []
+        return self._local.scopes
 
     # ---- scoping ----
     @contextmanager
@@ -65,43 +80,51 @@ class StatsTracker:
             m = np.asarray(mask)
             if m.dtype != np.bool_:
                 m = m.astype(bool)
-            self._denoms[self._key(name)] = m
+            with self._lock:
+                self._denoms[self._key(name)] = m
 
     def stat(
         self, denominator: str, reduce_type: ReduceType = ReduceType.AVG, **kwargs
     ) -> None:
         """Record vector stats reduced over the elements selected by the named
         denominator mask."""
-        dkey = self._key(denominator)
-        if dkey not in self._denoms:
-            raise ValueError(f"unknown denominator {dkey}")
-        mask = self._denoms[dkey]
-        for name, value in kwargs.items():
-            v = np.asarray(value, dtype=np.float64)
-            key = self._key(name)
-            prev = self._stats.get(key)
-            if prev is not None and prev[0] != reduce_type:
-                raise ValueError(f"conflicting reduce types for {key}")
-            entries = prev[1] if prev else []
-            entries.append((v, mask))
-            self._stats[key] = (reduce_type, entries)
+        with self._lock:
+            dkey = self._key(denominator)
+            if dkey not in self._denoms:
+                raise ValueError(f"unknown denominator {dkey}")
+            mask = self._denoms[dkey]
+            for name, value in kwargs.items():
+                v = np.asarray(value, dtype=np.float64)
+                key = self._key(name)
+                prev = self._stats.get(key)
+                if prev is not None and prev[0] != reduce_type:
+                    raise ValueError(f"conflicting reduce types for {key}")
+                entries = prev[1] if prev else []
+                entries.append((v, mask))
+                self._stats[key] = (reduce_type, entries)
 
     def scalar(self, **kwargs) -> None:
-        for name, value in kwargs.items():
-            key = self._key(name)
-            prev = self._stats.get(key)
-            entries = prev[1] if prev else []
-            entries.append((float(value), None))
-            self._stats[key] = (ReduceType.SCALAR, entries)
+        with self._lock:
+            for name, value in kwargs.items():
+                key = self._key(name)
+                prev = self._stats.get(key)
+                entries = prev[1] if prev else []
+                entries.append((float(value), None))
+                self._stats[key] = (ReduceType.SCALAR, entries)
 
     def moving_avg(self, decay: float = 0.99, **kwargs) -> None:
-        for name, value in kwargs.items():
-            key = self._key(name)
-            old = self._moving.get(key, float(value))
-            self._moving[key] = decay * old + (1 - decay) * float(value)
+        with self._lock:
+            for name, value in kwargs.items():
+                key = self._key(name)
+                old = self._moving.get(key, float(value))
+                self._moving[key] = decay * old + (1 - decay) * float(value)
 
     # ---- export ----
     def export(self, reset: bool = True) -> Dict[str, float]:
+        with self._lock:
+            return self._export_locked(reset)
+
+    def _export_locked(self, reset: bool) -> Dict[str, float]:
         out: Dict[str, float] = {}
         for key, (rtype, entries) in self._stats.items():
             if rtype == ReduceType.SCALAR:

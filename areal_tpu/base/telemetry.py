@@ -1,0 +1,843 @@
+"""Unified telemetry: per-process metric registry, trace spans, cross-worker
+aggregation, Prometheus rendering, and on-demand profiler capture.
+
+The paper's core claim — fully-async rollout/training overlap hides
+generation latency — is only checkable if queue depth, staleness lag,
+weight-sync fanout latency, and the trainer's step-phase breakdown are
+visible across the fleet *while it runs*. ``stats_tracker`` covers the
+training-loss plane (per-step scoped reductions the master tabulates);
+this module covers the *systems* plane on top of it:
+
+ - :class:`TelemetryRegistry` — per-process counters (monotonic), gauges
+   (last value), histograms (fixed buckets, Prometheus-style cumulative),
+   and lightweight trace spans (id / parent-id / wall-times, nested via a
+   contextvar so asyncio tasks and threads each get a correct parent
+   chain).
+ - :class:`TelemetryPusher` — background thread that snapshots the
+   registry every ``flush_interval_secs`` and ZMQ-PUSHes it to the
+   master, tagged ``(worker_kind, worker_index)``. Endpoint discovery is
+   lazy (the aggregator may start after the worker); until it appears,
+   snapshots accumulate spans up to a bounded buffer.
+ - :class:`TelemetryAggregator` — master-side PULL endpoint (registered
+   under ``names.telemetry_aggregator``) merging per-worker snapshots
+   into one state keyed by ``worker_kind:worker_index``, appending every
+   snapshot to ``telemetry.jsonl`` and mirroring scalars into a
+   :class:`base.monitor.MetricWriter` tensorboard stream. With
+   ``http_port > 0`` it also serves the merged fleet state as
+   Prometheus text on ``GET /metrics``.
+ - :func:`render_prometheus` — registry/plain-dict → Prometheus
+   exposition text (the generation server and gserver manager serve it
+   on their existing aiohttp apps).
+ - Profiler trigger — :func:`request_profiler_capture` writes a
+   name-resolve flag (``names.profiler_trigger``) that a trainer-side
+   :class:`ProfilerTriggerWatcher` polls between serve iterations; on
+   pickup it runs ``jax.profiler.start_trace/stop_trace`` for the
+   requested window and reports under ``names.profiler_status``.
+
+Disabled-by-default contract (tier-1 + bench honesty): until
+:func:`configure` is called with an enabled config, the module-level API
+(:func:`inc`, :func:`set_gauge`, :func:`observe`, :func:`span`) routes to
+a shared null object — no locks taken beyond one attribute read, no ZMQ
+sockets, no HTTP servers, no span allocation.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+import itertools
+import json
+import os
+import pickle
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("base.telemetry")
+
+# Latency-shaped default buckets (seconds): 1ms .. ~2min, Prometheus-style.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+_span_ids = itertools.count(1)
+# Current span id of the calling context (asyncio task / thread); copied
+# into child tasks by asyncio, fresh (None) in new threads.
+_CUR_SPAN: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "areal_tpu_cur_span", default=None
+)
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    t_start: float  # wall clock (time.time)
+    dur_secs: float
+    attrs: Dict[str, Any]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "t_start": round(self.t_start, 6),
+            "dur_secs": round(self.dur_secs, 6),
+            "attrs": self.attrs,
+        }
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # trailing +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "buckets": list(self.buckets), "counts": list(self.counts),
+            "sum": self.sum, "count": self.count,
+        }
+
+
+class TelemetryRegistry:
+    """Thread-safe per-process metric + span store.
+
+    Counters/gauges/histograms are CUMULATIVE — a flush (or a Prometheus
+    scrape) never resets them, so scraped counters stay monotonic and
+    concurrent exporters cannot race each other's resets. Spans are the
+    only drained state: ``snapshot(reset=True)`` hands back the buffered
+    spans and clears the buffer (bounded by ``max_spans``; oldest drop
+    first so a stalled aggregator cannot OOM a worker).
+    """
+
+    def __init__(self, max_spans: int = 4096):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, _Histogram] = {}
+        self._spans: List[Span] = []
+        self.max_spans = max_spans
+        self.dropped_spans = 0
+
+    # ---- metrics ----
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + n
+
+    def set_gauge(self, name: str, v: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(v)
+
+    def observe(self, name: str, v: float,
+                buckets: Optional[Sequence[float]] = None) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram(buckets or DEFAULT_BUCKETS)
+            h.observe(float(v))
+
+    # ---- spans ----
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        sid = next(_span_ids)
+        parent = _CUR_SPAN.get()
+        token = _CUR_SPAN.set(sid)
+        t_wall = time.time()
+        t0 = time.monotonic()
+        try:
+            yield attrs  # callers may add attrs["key"] = ... mid-span
+        finally:
+            _CUR_SPAN.reset(token)
+            s = Span(name=name, span_id=sid, parent_id=parent,
+                     t_start=t_wall, dur_secs=time.monotonic() - t0,
+                     attrs=attrs)
+            with self._lock:
+                if len(self._spans) >= self.max_spans:
+                    self._spans.pop(0)
+                    self.dropped_spans += 1
+                self._spans.append(s)
+            # Every span doubles as a duration histogram point, so the
+            # aggregate view exists even when span volume forces drops.
+            self.observe(f"{name}/secs", s.dur_secs)
+
+    # ---- export ----
+
+    def snapshot(self, reset: bool = True) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "hists": {k: h.as_dict() for k, h in self._hists.items()},
+                "spans": [s.as_dict() for s in self._spans],
+                "dropped_spans": self.dropped_spans,
+            }
+            if reset:
+                self._spans = []
+        return out
+
+
+# --------------------------------------------------------------------------
+# Prometheus rendering
+# --------------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    return ("_" + s) if s and s[0].isdigit() else (s or "_")
+
+
+def _prom_labels(labels: Optional[Dict[str, str]],
+                 extra: Optional[Dict[str, str]] = None) -> str:
+    merged = {**(labels or {}), **(extra or {})}
+    if not merged:
+        return ""
+
+    def esc(v) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+    inner = ",".join(
+        f'{_prom_name(k)}="{esc(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_prometheus(
+    snapshot: Optional[Dict[str, Any]] = None,
+    extra_gauges: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    prefix: str = "areal",
+) -> str:
+    """Registry snapshot (+ ad-hoc gauges) → Prometheus exposition text.
+
+    ``extra_gauges`` lets HTTP workers export live object state (queue
+    sizes, versions) without mirroring it into the registry first. Values
+    that are None or non-numeric are skipped.
+    """
+    lines: List[str] = []
+    snapshot = snapshot or {}
+    lab = _prom_labels(labels)
+
+    def emit(name: str, kind: str, value: float,
+             label_str: Optional[str] = None) -> None:
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name}{lab if label_str is None else label_str} "
+                     f"{float(value):g}")
+
+    emitted = set()
+    for k, v in sorted((extra_gauges or {}).items()):
+        if isinstance(v, bool):
+            v = float(v)
+        if not isinstance(v, (int, float)):
+            continue  # None / strings have no Prometheus representation
+        name = f"{prefix}_{_prom_name(k)}"
+        emitted.add(name)
+        emit(name, "gauge", float(v))
+    for k, v in sorted(snapshot.get("gauges", {}).items()):
+        name = f"{prefix}_{_prom_name(k)}"
+        if name in emitted:
+            # extra_gauges win: a registry gauge sanitizing to the same
+            # name (e.g. genserver/weight_version vs the live-state
+            # gauge) must not produce a duplicate Prometheus sample.
+            continue
+        emit(name, "gauge", v)
+    for k, v in sorted(snapshot.get("counters", {}).items()):
+        emit(f"{prefix}_{_prom_name(k)}_total", "counter", v)
+    for k, h in sorted(snapshot.get("hists", {}).items()):
+        base = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {base} histogram")
+        cum = 0
+        for b, c in zip(h["buckets"], h["counts"]):
+            cum += c
+            lstr = _prom_labels(labels, {"le": f"{float(b):g}"})
+            lines.append(f"{base}_bucket{lstr} {cum}")
+        cum += h["counts"][-1]
+        lines.append(f"{base}_bucket{_prom_labels(labels, {'le': '+Inf'})} "
+                     f"{cum}")
+        lines.append(f"{base}_sum{lab} {h['sum']:g}")
+        lines.append(f"{base}_count{lab} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# pusher (worker side)
+# --------------------------------------------------------------------------
+
+
+class TelemetryPusher:
+    """Flush a registry to the master's aggregator on an interval.
+
+    Discovery is lazy and non-fatal: the PUSH socket connects the first
+    time ``names.telemetry_aggregator`` resolves; until then flushes are
+    skipped (spans stay buffered in the registry, bounded)."""
+
+    def __init__(self, registry: TelemetryRegistry, experiment: str,
+                 trial: str, worker_kind: str, worker_index: int = 0,
+                 flush_interval_secs: float = 2.0):
+        self.registry = registry
+        self.worker_kind = worker_kind
+        self.worker_index = worker_index
+        self.flush_interval_secs = flush_interval_secs
+        self._key = names.telemetry_aggregator(experiment, trial)
+        self._sock = None
+        self._flush_lock = threading.Lock()  # socket use is single-file
+        self._pending: Optional[bytes] = None  # unsent snapshot (backlog)
+        self._closing = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"telemetry-push-{worker_kind}{worker_index}",
+        )
+        self._thread.start()
+
+    def _connect(self) -> bool:
+        if self._sock is not None:
+            return True
+        try:
+            addr = name_resolve.get(self._key)
+        except Exception:  # noqa: BLE001 — aggregator not up yet
+            return False
+        import zmq
+
+        self._sock = zmq.Context.instance().socket(zmq.PUSH)
+        self._sock.setsockopt(zmq.SNDHWM, 64)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(addr)
+        return True
+
+    def flush(self) -> bool:
+        """One snapshot push; returns False when no aggregator is known or
+        it is backlogged. A snapshot that cannot be sent is kept (and the
+        registry is NOT drained again until it goes out), so a stalled
+        aggregator loses no spans — exactly the incident window an
+        operator will want to see. The registry's bounded span buffer is
+        the backstop if the outage outlasts ``max_buffered_spans``."""
+        import zmq
+
+        with self._flush_lock:
+            if not self._connect():
+                return False
+            if self._pending is not None:
+                try:
+                    self._sock.send(self._pending, zmq.NOBLOCK)
+                except zmq.Again:
+                    return False  # still backlogged; nothing drained
+                self._pending = None
+            payload = pickle.dumps({
+                "worker_kind": self.worker_kind,
+                "worker_index": self.worker_index,
+                "time": time.time(),
+                **self.registry.snapshot(reset=True),
+            })
+            try:
+                self._sock.send(payload, zmq.NOBLOCK)
+            except zmq.Again:
+                self._pending = payload
+                return False
+        return True
+
+    def _loop(self) -> None:
+        while not self._closing.wait(self.flush_interval_secs):
+            try:
+                self.flush()
+            except Exception as e:  # noqa: BLE001 — telemetry never kills
+                logger.warning(f"telemetry flush failed: {e}")
+
+    def close(self) -> None:
+        # ZMQ sockets are not thread-safe: stop the flush thread BEFORE
+        # touching the socket from this thread. If the join times out
+        # (thread wedged mid-flush), leak the socket to the daemon thread
+        # rather than race it — the process is exiting anyway.
+        self._closing.set()
+        self._thread.join(timeout=2)
+        if self._thread.is_alive():
+            return
+        try:
+            self.flush()  # final snapshot (best-effort)
+        except Exception:  # noqa: BLE001
+            pass
+        if self._sock is not None:
+            self._sock.close(linger=0)
+            self._sock = None
+
+
+# --------------------------------------------------------------------------
+# aggregator (master side)
+# --------------------------------------------------------------------------
+
+
+class TelemetryAggregator:
+    """PULL-side merge of per-worker snapshots keyed by
+    ``worker_kind:worker_index``; every received snapshot is appended to
+    ``telemetry.jsonl`` and its scalars mirrored into ``metric_writer``
+    (tensorboard) as ``telemetry/{worker}/{metric}``."""
+
+    def __init__(self, experiment: str, trial: str,
+                 jsonl_path: Optional[str] = None,
+                 metric_writer=None, http_port: int = 0):
+        import zmq
+
+        self.jsonl_path = jsonl_path
+        self._writer = metric_writer
+        self._seq = 0
+        self.state: Dict[str, Dict[str, Any]] = {}
+        self._state_lock = threading.Lock()
+        self._sock = zmq.Context.instance().socket(zmq.PULL)
+        self._sock.setsockopt(zmq.RCVHWM, 4096)
+        port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
+        self._key = names.telemetry_aggregator(experiment, trial)
+        name_resolve.add(self._key, network.advertised_tcp(port),
+                         replace=True)
+        self._jsonl_file = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._jsonl_file = open(jsonl_path, "a", buffering=1)
+        self._closing = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="telemetry-aggregate"
+        )
+        self._thread.start()
+        self._http = None
+        if http_port:
+            self._start_http(http_port)
+        logger.info(f"telemetry aggregator up (jsonl={jsonl_path})")
+
+    # ---- ingest ----
+
+    def _ingest(self, payload: Dict[str, Any]) -> None:
+        worker = f"{payload.get('worker_kind', '?')}:" \
+                 f"{payload.get('worker_index', 0)}"
+        with self._state_lock:
+            prev = self.state.get(worker)
+            spans = payload.get("spans", [])
+            merged = {
+                "time": payload.get("time"),
+                "counters": payload.get("counters", {}),
+                "gauges": payload.get("gauges", {}),
+                "hists": payload.get("hists", {}),
+                "n_spans": (prev["n_spans"] if prev else 0) + len(spans),
+                "last_spans": spans or (prev["last_spans"] if prev else []),
+            }
+            self.state[worker] = merged
+            self._seq += 1
+            seq = self._seq
+        if self._jsonl_file is not None:
+            rec = {"worker": worker, **{
+                k: payload.get(k) for k in
+                ("time", "counters", "gauges", "spans", "dropped_spans")
+            }, "hists": payload.get("hists", {})}
+            self._jsonl_file.write(json.dumps(rec) + "\n")
+        if self._writer is not None:
+            flat = {
+                **{f"telemetry/{worker}/{k}": v
+                   for k, v in merged["counters"].items()},
+                **{f"telemetry/{worker}/{k}": v
+                   for k, v in merged["gauges"].items()},
+            }
+            if flat:
+                try:
+                    self._writer.write(flat, seq)
+                except Exception:  # noqa: BLE001 — TB is best-effort
+                    pass
+
+    def _loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                if not self._sock.poll(100):
+                    continue
+                self._ingest(pickle.loads(self._sock.recv()))
+            except Exception as e:  # noqa: BLE001 — aggregator must survive
+                if not self._closing.is_set():
+                    logger.warning(f"telemetry ingest failed: {e}")
+
+    def set_metric_writer(self, writer) -> None:
+        """Attach (or swap) the tensorboard mirror after construction —
+        the master builds its MetricWriter later in setup."""
+        self._writer = writer
+
+    # ---- views ----
+
+    def merged(self) -> Dict[str, Dict[str, Any]]:
+        with self._state_lock:
+            return {k: dict(v) for k, v in self.state.items()}
+
+    def render_prometheus(self) -> str:
+        """Merged fleet state as ONE valid exposition: samples of the same
+        metric family (e.g. two rollout workers' gauges) are grouped under
+        a single ``# TYPE`` line — concatenating per-worker renderings
+        would emit duplicate TYPE lines, which expfmt-based consumers
+        (promtool etc.) reject wholesale."""
+        fams: Dict[str, Dict[str, Any]] = {}
+
+        def add(name: str, kind: str, line: str) -> None:
+            fams.setdefault(name, {"kind": kind, "lines": []})["lines"] \
+                .append(line)
+
+        for worker, st in sorted(self.merged().items()):
+            kind, _, idx = worker.partition(":")
+            labels = {"worker_kind": kind, "worker_index": idx}
+            lab = _prom_labels(labels)
+            for k, v in sorted(st["gauges"].items()):
+                n = f"areal_{_prom_name(k)}"
+                add(n, "gauge", f"{n}{lab} {float(v):g}")
+            for k, v in sorted(st["counters"].items()):
+                n = f"areal_{_prom_name(k)}_total"
+                add(n, "counter", f"{n}{lab} {float(v):g}")
+            for k, h in sorted(st["hists"].items()):
+                base = f"areal_{_prom_name(k)}"
+                cum = 0
+                for b, c in zip(h["buckets"], h["counts"]):
+                    cum += c
+                    ls = _prom_labels(labels, {"le": f"{float(b):g}"})
+                    add(base, "histogram", f"{base}_bucket{ls} {cum}")
+                cum += h["counts"][-1]
+                ls = _prom_labels(labels, {"le": "+Inf"})
+                add(base, "histogram", f"{base}_bucket{ls} {cum}")
+                add(base, "histogram", f"{base}_sum{lab} {h['sum']:g}")
+                add(base, "histogram", f"{base}_count{lab} {h['count']}")
+        if not fams:
+            return "# no telemetry received yet\n"
+        out: List[str] = []
+        for name in sorted(fams):
+            out.append(f"# TYPE {name} {fams[name]['kind']}")
+            out.extend(fams[name]["lines"])
+        return "\n".join(out) + "\n"
+
+    # ---- optional unified /metrics over plain http ----
+
+    def _start_http(self, port: int) -> None:
+        import http.server
+
+        agg = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = agg.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: D102 — silence stdlib logs
+                pass
+
+        self._http = http.server.ThreadingHTTPServer(
+            (network.bind_addr(), port), Handler
+        )
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="telemetry-http").start()
+
+    def close(self) -> None:
+        # ZMQ sockets are not thread-safe: stop the ingest thread BEFORE
+        # this thread touches the socket for the final drain. A wedged
+        # ingest thread (slow tensorboard/NFS write) keeps the socket —
+        # skip the drain rather than race a live poll/recv.
+        self._closing.set()
+        self._thread.join(timeout=2)
+        try:
+            name_resolve.delete(self._key)
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
+        if not self._thread.is_alive():
+            # One last drain so snapshots pushed during shutdown land.
+            try:
+                while self._sock.poll(50):
+                    self._ingest(pickle.loads(self._sock.recv()))
+            except Exception:  # noqa: BLE001
+                pass
+            self._sock.close(linger=0)
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+
+
+# --------------------------------------------------------------------------
+# process-global facade
+# --------------------------------------------------------------------------
+
+
+class _NullSpanCtx:
+    """Reusable no-op span context (allocation-free disabled path)."""
+
+    _attrs: Dict[str, Any] = {}
+
+    def __enter__(self):
+        return {}
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpanCtx()
+
+
+class Telemetry:
+    """A (registry, pusher) bundle — the unit each worker owns.
+
+    The gen-fleet process hosts generation servers AND the manager in one
+    process, so they each construct their own instance (distinct
+    ``worker_kind`` keys at the aggregator) rather than sharing the
+    process-global one."""
+
+    def __init__(self, experiment: str, trial: str, worker_kind: str,
+                 worker_index: int = 0, cfg: Optional["TelemetryConfig"] = None,
+                 push: bool = True):
+        from areal_tpu.api.train_config import TelemetryConfig
+
+        cfg = cfg or TelemetryConfig(enabled=True)
+        self.cfg = cfg
+        self.registry = TelemetryRegistry(max_spans=cfg.max_buffered_spans)
+        self.pusher = (
+            TelemetryPusher(
+                self.registry, experiment, trial, worker_kind, worker_index,
+                flush_interval_secs=cfg.flush_interval_secs,
+            ) if push else None
+        )
+
+    enabled = True
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        self.registry.inc(name, n)
+
+    def set_gauge(self, name: str, v: float) -> None:
+        self.registry.set_gauge(name, v)
+
+    def observe(self, name: str, v: float, buckets=None) -> None:
+        self.registry.observe(name, v, buckets)
+
+    def span(self, name: str, **attrs):
+        return self.registry.span(name, **attrs)
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        return self.registry.snapshot(reset=reset)
+
+    def close(self) -> None:
+        if self.pusher is not None:
+            self.pusher.close()
+            self.pusher = None
+
+
+class _NullTelemetry:
+    """Shared disabled sink: no sockets, no threads, no span objects."""
+
+    enabled = False
+    registry = None
+    pusher = None
+
+    def inc(self, name: str, n: float = 1.0) -> None:
+        pass
+
+    def set_gauge(self, name: str, v: float) -> None:
+        pass
+
+    def observe(self, name: str, v: float, buckets=None) -> None:
+        pass
+
+    def span(self, name: str, **attrs):
+        return _NULL_SPAN
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        return {"counters": {}, "gauges": {}, "hists": {}, "spans": [],
+                "dropped_spans": 0}
+
+    def close(self) -> None:
+        pass
+
+
+NULL = _NullTelemetry()
+_GLOBAL: Any = NULL
+
+
+def configure(experiment: str, trial: str, worker_kind: str,
+              worker_index: int = 0, cfg=None, push: bool = True):
+    """Install the process-global telemetry sink. A disabled (or absent)
+    config keeps the null sink — callers never need to re-check."""
+    global _GLOBAL
+    if cfg is not None and not cfg.enabled:
+        return NULL
+    if _GLOBAL is not NULL:
+        _GLOBAL.close()
+    _GLOBAL = Telemetry(experiment, trial, worker_kind, worker_index,
+                        cfg=cfg, push=push)
+    return _GLOBAL
+
+
+def get():
+    return _GLOBAL
+
+
+def enabled() -> bool:
+    return _GLOBAL.enabled
+
+
+def shutdown() -> None:
+    global _GLOBAL
+    if _GLOBAL is not NULL:
+        _GLOBAL.close()
+        _GLOBAL = NULL
+
+
+def inc(name: str, n: float = 1.0) -> None:
+    _GLOBAL.inc(name, n)
+
+
+def set_gauge(name: str, v: float) -> None:
+    _GLOBAL.set_gauge(name, v)
+
+
+def observe(name: str, v: float, buckets=None) -> None:
+    _GLOBAL.observe(name, v, buckets)
+
+
+def span(name: str, **attrs):
+    return _GLOBAL.span(name, **attrs)
+
+
+# --------------------------------------------------------------------------
+# on-demand profiler capture
+# --------------------------------------------------------------------------
+
+
+def request_profiler_capture(experiment: str, trial: str, out_dir: str,
+                             secs: float = 5.0) -> None:
+    """Operator entry (tools/perf_probe.py): ask the trainer for one
+    ``jax.profiler`` trace of ~``secs`` seconds into ``out_dir``."""
+    name_resolve.add(
+        names.profiler_trigger(experiment, trial),
+        json.dumps({"dir": out_dir, "secs": float(secs)}),
+        replace=True,
+    )
+
+
+def read_profiler_status(experiment: str, trial: str) -> Optional[Dict]:
+    try:
+        return json.loads(name_resolve.get(
+            names.profiler_status(experiment, trial)
+        ))
+    except Exception:  # noqa: BLE001 — never captured yet
+        return None
+
+
+class ProfilerTriggerWatcher:
+    """Trainer-side poller for the profiler-trigger flag.
+
+    ``poll()`` is called once per serve-loop iteration; it rate-limits
+    the name-resolve read to ``poll_secs`` so the hot loop never pays a
+    filesystem stat per iteration. On pickup: consume the flag, start a
+    ``jax.profiler`` trace, and stop it once the requested window has
+    elapsed (checked on subsequent polls), publishing the outcome under
+    ``names.profiler_status``. ``start_fn``/``stop_fn`` are injectable
+    for tests (and guard environments where the profiler is unavailable).
+    """
+
+    def __init__(self, experiment: str, trial: str, poll_secs: float = 1.0,
+                 start_fn=None, stop_fn=None):
+        self.experiment = experiment
+        self.trial = trial
+        self.poll_secs = poll_secs
+        self._trigger_key = names.profiler_trigger(experiment, trial)
+        self._status_key = names.profiler_status(experiment, trial)
+        self._next_check = 0.0
+        self._deadline: Optional[float] = None
+        self._out_dir: Optional[str] = None
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+
+    def _start(self, out_dir: str) -> None:
+        if self._start_fn is not None:
+            self._start_fn(out_dir)
+            return
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+
+    def _stop(self) -> None:
+        if self._stop_fn is not None:
+            self._stop_fn()
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+
+    def _set_status(self, state: str, **extra) -> None:
+        name_resolve.add(
+            self._status_key,
+            json.dumps({"state": state, "dir": self._out_dir,
+                        "time": time.time(), **extra}),
+            replace=True,
+        )
+
+    @property
+    def capturing(self) -> bool:
+        return self._deadline is not None
+
+    def poll(self) -> None:
+        now = time.monotonic()
+        if self.capturing:
+            if now >= self._deadline:
+                self._deadline = None
+                try:
+                    self._stop()
+                    self._set_status("done")
+                    logger.info(f"profiler capture done -> {self._out_dir}")
+                except Exception as e:  # noqa: BLE001 — never kill serving
+                    self._set_status("failed", error=str(e))
+                    logger.warning(f"profiler stop failed: {e}")
+            return
+        if now < self._next_check:
+            return
+        self._next_check = now + self.poll_secs
+        try:
+            raw = name_resolve.get(self._trigger_key)
+        except Exception:  # noqa: BLE001 — no trigger pending
+            return
+        try:
+            name_resolve.delete(self._trigger_key)  # consume exactly once
+        except Exception:  # noqa: BLE001 — raced another consumer
+            return
+        try:
+            req = json.loads(raw)
+            self._out_dir = req["dir"]
+            secs = float(req.get("secs", 5.0))
+            self._start(self._out_dir)
+            self._deadline = now + secs
+            self._set_status("capturing", secs=secs)
+            logger.info(
+                f"profiler capture started ({secs}s) -> {self._out_dir}"
+            )
+        except Exception as e:  # noqa: BLE001 — bad request / no profiler
+            self._deadline = None
+            self._set_status("failed", error=str(e))
+            logger.warning(f"profiler trigger failed: {e}")

@@ -56,6 +56,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 max_batch_size=self.gen_max_batch_size,
                 prompt_bucket=self.gen_prompt_bucket,
                 weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
+                telemetry=self.telemetry,
             )
             for i in range(n_gen)
         ]
@@ -69,6 +70,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             max_concurrent_rollouts=self.max_concurrent_rollouts,
             schedule_policy=self.schedule_policy,
             realloc_dir=paths["realloc"],
+            telemetry=self.telemetry,
         )
         rollout_workers = [
             RolloutWorkerConfig(
@@ -87,6 +89,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 # Async-recovery skiplist lives next to the master's
                 # recover checkpoints (rollout_worker.ConsumedLog).
                 recover_dir=paths["recover"],
+                telemetry=self.telemetry,
             )
             for i in range(self.n_rollout_workers)
         ]

@@ -321,6 +321,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             stream_dataset=async_mode,
             realloc_dir=paths["realloc"],
             weight_sync=self.weight_sync,
+            telemetry=self.telemetry,
         )
 
     def build_master_config(self, async_mode: bool = False):
@@ -337,6 +338,15 @@ class PPOMATHConfig(BaseExperimentConfig):
             bs *= self.group_size
         import os
 
+        # The master hosts the aggregator; its telemetry.jsonl defaults
+        # next to the run's tensorboard stream under the log dir.
+        tel = dataclasses.replace(
+            self.telemetry,
+            jsonl_path=(
+                self.telemetry.jsonl_path
+                or os.path.join(paths["log"], "telemetry.jsonl")
+            ),
+        )
         return MasterWorkerConfig(
             experiment=self.experiment_name, trial=self.trial_name,
             trainer_handler="trainer",
@@ -349,6 +359,7 @@ class PPOMATHConfig(BaseExperimentConfig):
                 or os.path.join(paths["log"], "tensorboard")
             ),
             wandb_mode=self.wandb.mode,
+            telemetry=tel,
             recover_dir=paths["recover"],
             recover=self.recover_mode == "resume",
         )

@@ -21,7 +21,8 @@ update from disk). TPU-first design differences:
    ZMQ (§3.5 low-latency path, system/weight_stream.py) or read from the
    published checkpoint (disk fallback).
 
-Endpoints: POST /generate, POST /update_weights, GET /health, GET /metrics.
+Endpoints: POST /generate, POST /update_weights, GET /health,
+GET /metrics (Prometheus text), GET /metrics.json (structured).
 """
 
 from __future__ import annotations
@@ -34,7 +35,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from areal_tpu.api.model import GenerationHyperparameters
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer  # noqa: F401 (engine deps)
 
@@ -66,6 +68,12 @@ class GenerationServerConfig:
     # In-flight chunk requests when consuming a streamed weight update
     # (weight_sync.pipeline_depth threaded through the experiment config).
     weight_stream_pipeline_depth: int = 4
+    # Unified telemetry (base/telemetry.py). The gen-fleet process hosts
+    # servers AND the manager, so each owns its own instance (distinct
+    # worker kinds at the aggregator) instead of the process global.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
 
 class _Pending:
@@ -121,6 +129,16 @@ class GenerationServer:
         self._runner_task = None
         self._states: Dict[str, _ReqState] = {}
         self._last_update_latency = 0.0
+        self._inflight = 0  # /generate requests accepted but not replied
+        self._last_stream_stats: Dict[str, float] = {}
+        # server_id "gen3" → worker_index 3 at the aggregator.
+        idx = "".join(c for c in cfg.server_id if c.isdigit())
+        self.telemetry = (
+            telemetry.Telemetry(
+                cfg.experiment, cfg.trial, "generation_server",
+                int(idx or 0), cfg=cfg.telemetry,
+            ) if cfg.telemetry.enabled else telemetry.NULL
+        )
 
     # ---------------- decode core ----------------
 
@@ -264,7 +282,17 @@ class GenerationServer:
             while len(batch) < cfg.max_batch_size and not self._queue.empty():
                 batch.append(self._queue.get_nowait())
             try:
-                results = await asyncio.to_thread(self._decode_batch, batch)
+                with self.telemetry.span("genserver/decode_chunk",
+                                         batch_size=len(batch)) as attrs:
+                    results = await asyncio.to_thread(
+                        self._decode_batch, batch
+                    )
+                    attrs["tokens"] = sum(
+                        len(r["output_ids"]) for r in results
+                    )
+                self.telemetry.inc("genserver/decode_chunks")
+                self.telemetry.inc("genserver/generated_tokens",
+                                   attrs["tokens"])
                 for p, r in zip(batch, results):
                     p.future.set_result(r)
             except asyncio.CancelledError:
@@ -290,15 +318,19 @@ class GenerationServer:
         d = await request.json()
         gconfig = GenerationHyperparameters(**d.get("gconfig", {}))
         fut = asyncio.get_running_loop().create_future()
-        await self._queue.put(_Pending(
-            prompt=np.asarray(d["prompt_ids"], np.int32),
-            gconfig=gconfig,
-            max_tokens=int(d.get("max_tokens", gconfig.max_new_tokens)),
-            future=fut,
-            rid=d.get("rid"),
-            tokens_done=int(d.get("tokens_done", 0)),
-        ))
-        return web.json_response(await fut)
+        self._inflight += 1
+        try:
+            await self._queue.put(_Pending(
+                prompt=np.asarray(d["prompt_ids"], np.int32),
+                gconfig=gconfig,
+                max_tokens=int(d.get("max_tokens", gconfig.max_new_tokens)),
+                future=fut,
+                rid=d.get("rid"),
+                tokens_done=int(d.get("tokens_done", 0)),
+            ))
+            return web.json_response(await fut)
+        finally:
+            self._inflight -= 1
 
     def _load_and_put_weights(self, path: str):
         """Host-side checkpoint read + device upload. Runs in a worker
@@ -372,6 +404,15 @@ class GenerationServer:
             consumer.verify_digest(version)
             new = unflatten_pytree(shadow)
             jax.block_until_ready(new)
+            # Per-leg stream stats for /metrics + telemetry: wire wait,
+            # digest/checksum CPU, and total bytes of this consume.
+            # Recorded ONLY on a verified success — a failed update must
+            # leave /metrics unchanged (the except handler's contract).
+            self._last_stream_stats = {
+                "stream_bytes": float(consumer.bytes_received),
+                "digest_verify_secs": consumer.checksum_secs,
+                "wire_wait_secs": consumer.wire_wait_secs,
+            }
             return new
         finally:
             consumer.close()
@@ -381,23 +422,28 @@ class GenerationServer:
 
         d = await request.json()
         t0 = time.monotonic()
+        transport = "stream" if d.get("endpoint") else "disk"
         try:
-            if d.get("endpoint"):
-                new = await asyncio.to_thread(
-                    self._stream_and_put_weights, d["endpoint"],
-                    int(d["version"]),
-                    d.get("timeout"),
-                )
-            else:
-                new = await asyncio.to_thread(
-                    self._load_and_put_weights, d["path"]
-                )
+            with self.telemetry.span("genserver/weight_update",
+                                     transport=transport,
+                                     version=int(d.get("version", -1))):
+                if d.get("endpoint"):
+                    new = await asyncio.to_thread(
+                        self._stream_and_put_weights, d["endpoint"],
+                        int(d["version"]),
+                        d.get("timeout"),
+                    )
+                else:
+                    new = await asyncio.to_thread(
+                        self._load_and_put_weights, d["path"]
+                    )
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — keep old weights, report
             # Old (params, version) stay live and /metrics unchanged; the
             # manager's fanout retry/eviction machinery owns what happens
             # to this server next (docs/fault_tolerance.md).
+            self.telemetry.inc("genserver/weight_update_failures")
             logger.error(f"weight update failed; keeping v{self.version}: {e}")
             return web.json_response(
                 {"ok": False, "version": self.version, "error": str(e)},
@@ -413,6 +459,13 @@ class GenerationServer:
         self._states.clear()
         dt = time.monotonic() - t0
         self._last_update_latency = dt
+        self.telemetry.set_gauge("genserver/weight_version", self.version)
+        self.telemetry.set_gauge("genserver/weight_update_secs", dt)
+        if transport == "stream":
+            # Disk updates must not republish the previous stream's stats
+            # as if they described this sync.
+            for k, v in self._last_stream_stats.items():
+                self.telemetry.set_gauge(f"genserver/{k}", v)
         logger.info(f"weights updated to v{self.version} in {dt:.2f}s")
         return web.json_response({"ok": True, "version": self.version,
                                   "latency_s": dt})
@@ -430,19 +483,51 @@ class GenerationServer:
             "uptime_secs": time.monotonic() - self._t_start,
         })
 
-    async def handle_metrics(self, request):
-        from aiohttp import web
-
+    def _metrics_dict(self) -> Dict[str, Any]:
         dt = max(time.monotonic() - self._t_start, 1e-6)
-        return web.json_response({
+        return {
             "generated_tokens": self._tokens_out,
             "prefill_tokens": self._prefill_tokens,
             "tokens_per_sec": self._tokens_out / dt,
             "kv_states": len(self._states),
             "kv_bytes": sum(s.nbytes for s in self._states.values()),
             "version": self.version,
+            "inflight_requests": self._inflight,
+            "queue_depth": self._queue.qsize() if self._queue else 0,
             "last_weight_update_latency_s": self._last_update_latency,
-        })
+            # Stats of the last SUCCESSFUL streamed consume (absent until
+            # one lands; a later disk update does not describe these).
+            **{f"last_stream_{k}": v
+               for k, v in self._last_stream_stats.items()},
+        }
+
+    async def handle_metrics(self, request):
+        """Prometheus exposition text (docs/observability.md): live server
+        state as ``areal_genserver_*`` gauges — including weight_version
+        and inflight_requests — plus this server's telemetry registry
+        (decode spans → histograms) when telemetry is enabled. The old
+        JSON body moved to ``/metrics.json``."""
+        from aiohttp import web
+
+        d = self._metrics_dict()
+        extra = {f"genserver_{k}": v for k, v in d.items()}
+        # Canonical gauge name, present from boot (the registry's copy
+        # only exists once the first /update_weights lands).
+        extra["genserver_weight_version"] = d["version"]
+        body = telemetry.render_prometheus(
+            self.telemetry.snapshot(reset=False),
+            extra_gauges=extra,
+            labels={"server_id": self.cfg.server_id},
+        )
+        return web.Response(
+            text=body, content_type="text/plain",
+            charset="utf-8", headers={"X-Prometheus-Version": "0.0.4"},
+        )
+
+    async def handle_metrics_json(self, request):
+        from aiohttp import web
+
+        return web.json_response(self._metrics_dict())
 
     def build_app(self):
         from aiohttp import web
@@ -452,6 +537,7 @@ class GenerationServer:
         app.router.add_post("/update_weights", self.handle_update_weights)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/metrics.json", self.handle_metrics_json)
         return app
 
     async def start(self) -> str:
@@ -487,4 +573,5 @@ class GenerationServer:
                 p = self._queue.get_nowait()
                 if not p.future.done():
                     p.future.set_exception(RuntimeError("server aborted"))
+        self.telemetry.close()
         await self._runner_obj.cleanup()

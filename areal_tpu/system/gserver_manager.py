@@ -34,7 +34,8 @@ import shutil
 import time
 from typing import Dict, List, Optional
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.base.retry import FaultInjector, RetryPolicy, aretry
 
 logger = logging.getLogger("system.gserver_mgr")
@@ -70,6 +71,11 @@ class GserverManagerConfig:
             max_attempts=2, base_delay_secs=0.2, max_delay_secs=2.0
         )
     )
+    # Unified telemetry (base/telemetry.py): fleet gauges, probe-outcome
+    # counters, fanout ack-latency histograms. Off by default.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
 
 @dataclasses.dataclass
@@ -80,6 +86,9 @@ class _ServerHealth:
     consecutive_failures: int = 0
     acked_version: int = 0  # last weight version this server confirmed
     evicted_reason: str = ""
+    # Most recent probe/push failure detail — kept even after the counter
+    # resets so an eviction can say WHY, not just which url.
+    last_failure: str = ""
     reconciling: bool = False  # re-admission weight push in flight
 
 
@@ -108,6 +117,12 @@ class GserverManager:
         self.last_sync_fanout_secs: Optional[float] = None
         self.last_sync_e2e_secs: Optional[float] = None
         self.sync_history: List[tuple] = []
+        self.telemetry = (
+            telemetry.Telemetry(
+                cfg.experiment, cfg.trial, "gserver_manager", 0,
+                cfg=cfg.telemetry,
+            ) if cfg.telemetry.enabled else telemetry.NULL
+        )
 
     # ---------------- discovery ----------------
 
@@ -142,8 +157,14 @@ class GserverManager:
         dropped = [lid for lid, (u, _) in self._leases.items() if u == url]
         for lid in dropped:
             del self._leases[lid]
+        self.telemetry.inc("gsmgr/evictions")
+        # The last probe/push failure is the actionable detail (connection
+        # refused vs timeout vs bad status) — the reason alone often only
+        # says "N consecutive health failures".
+        why = (f"; last failure: {st.last_failure}"
+               if st.last_failure and st.last_failure not in reason else "")
         logger.warning(
-            f"evicted {url} ({reason}); dropped {len(dropped)} leases, "
+            f"evicted {url} ({reason}{why}); dropped {len(dropped)} leases, "
             f"{len(self.servers)} servers remain"
         )
 
@@ -226,6 +247,8 @@ class GserverManager:
             raise
         except Exception as e:  # noqa: BLE001 — any probe failure counts
             st.consecutive_failures += 1
+            st.last_failure = f"health probe: {e!r}"
+            self.telemetry.inc("gsmgr/health_probe_failures")
             if (
                 st.routable
                 and st.consecutive_failures
@@ -235,6 +258,11 @@ class GserverManager:
                                  f"health failures ({e})")
             return
         st.consecutive_failures = 0
+        # A passing probe clears the failure detail — otherwise a later
+        # eviction via a NON-probe path (version regression, fanout no-ack)
+        # would attach an hours-stale probe error as its explanation.
+        st.last_failure = ""
+        self.telemetry.inc("gsmgr/health_probe_ok")
         if st.routable and int(body.get("version", 0)) < version_at_probe:
             # A routable server reporting an old version was restarted in
             # place (pinned port: same url, fresh process at base weights).
@@ -302,6 +330,22 @@ class GserverManager:
         await asyncio.gather(*[
             self._check_one(sess, u) for u in list(self.health)
         ])
+        self._update_fleet_gauges()
+
+    def _update_fleet_gauges(self) -> None:
+        t = self.telemetry
+        t.set_gauge("gsmgr/healthy_servers", len(self.servers))
+        t.set_gauge("gsmgr/known_servers", len(self.health))
+        t.set_gauge("gsmgr/lease_depth", len(self._leases))
+        t.set_gauge("gsmgr/running_rollouts", self.running_rollouts)
+        t.set_gauge("gsmgr/accepted_rollouts", self.accepted_rollouts)
+        t.set_gauge("gsmgr/weight_version", self.version)
+        if self.last_sync_fanout_secs is not None:
+            t.set_gauge("gsmgr/weight_sync_fanout_secs",
+                        self.last_sync_fanout_secs)
+        if self.last_sync_e2e_secs is not None:
+            t.set_gauge("gsmgr/weight_sync_e2e_secs",
+                        self.last_sync_e2e_secs)
 
     async def _health_loop(self):
         import aiohttp
@@ -439,6 +483,32 @@ class GserverManager:
         return web.json_response({"version": self.version})
 
     async def handle_metrics(self, request):
+        """Prometheus exposition text: fleet gauges (healthy servers,
+        lease depth, staleness counters, weight version, sync latency)
+        plus the manager's telemetry registry (probe/fanout counters and
+        histograms). The structured JSON body — including the per-server
+        fleet map — moved to ``/metrics.json``."""
+        from aiohttp import web
+
+        gauges = {
+            "gsmgr_weight_version": self.version,
+            "gsmgr_running_rollouts": self.running_rollouts,
+            "gsmgr_accepted_rollouts": self.accepted_rollouts,
+            "gsmgr_healthy_servers": len(self.servers),
+            "gsmgr_known_servers": len(self.health),
+            "gsmgr_lease_depth": len(self._leases),
+            "gsmgr_inflight_requests": sum(self._inflight.values()),
+            "gsmgr_staled": float(self.is_staled()),
+            "gsmgr_weight_sync_fanout_secs": self.last_sync_fanout_secs,
+            "gsmgr_weight_sync_e2e_secs": self.last_sync_e2e_secs,
+        }
+        body = telemetry.render_prometheus(
+            self.telemetry.snapshot(reset=False), extra_gauges=gauges,
+        )
+        return web.Response(text=body, content_type="text/plain",
+                            charset="utf-8")
+
+    async def handle_metrics_json(self, request):
         from aiohttp import web
 
         hist = self.sync_history[-20:]
@@ -454,6 +524,7 @@ class GserverManager:
                     "consecutive_failures": st.consecutive_failures,
                     "acked_version": st.acked_version,
                     "evicted_reason": st.evicted_reason,
+                    "last_failure": st.last_failure,
                 }
                 for u, st in self.health.items()
             },
@@ -510,6 +581,7 @@ class GserverManager:
                 await r.read()
             return True
 
+        t0 = time.monotonic()
         try:
             await aretry(
                 _post, self.cfg.fanout_retry,
@@ -521,8 +593,14 @@ class GserverManager:
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — ack failure, not fatal
+            st = self.health.get(url)
+            if st is not None:
+                st.last_failure = f"weight push v{v}: {e!r}"
+            self.telemetry.inc("gsmgr/fanout_failures")
             logger.warning(f"weight push v{v} -> {url} gave up: {e}")
             return False
+        self.telemetry.observe("gsmgr/fanout_ack_secs",
+                               time.monotonic() - t0)
         st = self.health.get(url)
         if st is not None:  # entry may have been pruned mid-push
             st.acked_version = v
@@ -610,6 +688,7 @@ class GserverManager:
                 self.last_sync_fanout_secs = fanout_secs
                 self.last_sync_e2e_secs = e2e_secs
                 self.sync_history.append((v, fanout_secs, e2e_secs))
+                self._update_fleet_gauges()
                 logger.info(
                     f"weight sync v{v}: fanout {fanout_secs:.2f}s over "
                     f"{len(self.servers)} servers"
@@ -644,6 +723,7 @@ class GserverManager:
         app.router.add_post("/finish_rollout", self.handle_finish_rollout)
         app.router.add_get("/get_model_version", self.handle_get_model_version)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/metrics.json", self.handle_metrics_json)
         app.router.add_get("/metrics_discovery", self.handle_metrics_discovery)
         return app
 
@@ -679,4 +759,5 @@ class GserverManager:
         # destroyed-pending-task noise.
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        self.telemetry.close()
         await self._runner_obj.cleanup()

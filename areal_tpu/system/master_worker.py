@@ -29,7 +29,7 @@ from areal_tpu.api.dfg import (
     ParamReallocHook,
     WeightUpdateHook,
 )
-from areal_tpu.base import logging
+from areal_tpu.base import logging, telemetry
 from areal_tpu.base.stats_tracker import StatsTracker
 from areal_tpu.base.timeutil import FrequencyControl
 from areal_tpu.system.buffer import AsyncSequenceBuffer
@@ -40,7 +40,10 @@ logger = logging.getLogger("system.master")
 
 # Canonical home is the dependency-free api.train_config; re-exported here
 # because this module historically defined it.
-from areal_tpu.api.train_config import ExperimentSaveEvalControl  # noqa: E402,F401
+from areal_tpu.api.train_config import (  # noqa: E402,F401
+    ExperimentSaveEvalControl,
+    TelemetryConfig,
+)
 
 
 @dataclasses.dataclass
@@ -58,6 +61,12 @@ class MasterWorkerConfig:
     # observability (reference master_worker.py:291-350)
     tensorboard_path: Optional[str] = None
     wandb_mode: str = "disabled"
+    # Unified telemetry (base/telemetry.py): the master hosts the
+    # cross-worker aggregator (telemetry.jsonl + tensorboard mirror +
+    # optional Prometheus http port). Off by default.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
     # recover checkpoints (RecoverInfo + trainer train-state) live here
     recover_dir: str = ""
     # resume from the latest recover checkpoint at startup
@@ -104,6 +113,29 @@ class MasterWorker:
         self.ctrl = WorkerControl(
             self.cfg.experiment, self.cfg.trial, "master"
         )
+        # The aggregator MUST exist before any worker's pusher looks for
+        # it, and before the master's own telemetry configures — so it is
+        # the first telemetry object up. Disabled config: nothing starts.
+        self._aggregator = None
+        if self.cfg.telemetry.enabled:
+            import os
+
+            # Default next to the tensorboard stream (the log dir), per
+            # the TelemetryConfig contract; the checkpoint dir is only
+            # the last resort for bare configs with no tensorboard path.
+            jsonl = self.cfg.telemetry.jsonl_path or os.path.join(
+                os.path.dirname(self.cfg.tensorboard_path)
+                if self.cfg.tensorboard_path else self.cfg.save_dir,
+                "telemetry.jsonl",
+            )
+            self._aggregator = telemetry.TelemetryAggregator(
+                self.cfg.experiment, self.cfg.trial, jsonl_path=jsonl,
+                http_port=self.cfg.telemetry.http_port,
+            )
+            telemetry.configure(
+                self.cfg.experiment, self.cfg.trial, "master", 0,
+                self.cfg.telemetry,
+            )
         self.stream = MasterRequestStream(
             self.cfg.experiment, self.cfg.trial, [self.cfg.trainer_handler]
         )
@@ -118,6 +150,10 @@ class MasterWorker:
             tensorboard_path=self.cfg.tensorboard_path,
             wandb_mode=self.cfg.wandb_mode,
         )
+        if self._aggregator is not None:
+            # Mirror per-worker telemetry scalars into the same tensorboard
+            # stream as the training stats (telemetry/{worker}/{metric}).
+            self._aggregator.set_metric_writer(self._writer)
         if self.cfg.recover and self.cfg.recover_dir:
             self._try_recover()
 
@@ -242,9 +278,10 @@ class MasterWorker:
         return out
 
     async def _run_mfc(self, node: MFCDef) -> None:
-        metas = await self.buffer.get_batch_for_rpc(
-            node.name, set(node.input_keys), node.n_seqs
-        )
+        with telemetry.span("master/mfc_gate", mfc=node.name):
+            metas = await self.buffer.get_batch_for_rpc(
+                node.name, set(node.input_keys), node.n_seqs
+            )
         t_mfc = time.monotonic()
         self._count_mfc_flops(node, metas)
         ids = [m.ids[0] for m in metas]
@@ -264,7 +301,9 @@ class MasterWorker:
             post_hooks=self._hook_dicts(node, post=True),
         )
         rid = self.stream.post(payload)
-        reply = (await asyncio.to_thread(self.stream.gather, [rid]))[0]
+        with telemetry.span("master/mfc_exec", mfc=node.name,
+                            n_seqs=len(ids)):
+            reply = (await asyncio.to_thread(self.stream.gather, [rid]))[0]
         out = reply.output
         if node.interface_type == MFCInterfaceType.TRAIN_STEP:
             if out["stats"]:
@@ -318,7 +357,8 @@ class MasterWorker:
                 logger.info("master: exit requested via control channel")
                 break
             t0 = time.monotonic()
-            await self._execute_step()
+            with telemetry.span("master/step", step=self.step):
+                await self._execute_step()
             self.step += 1
             step_stats = self.stats.export(reset=True)
             dt = time.monotonic() - t0
@@ -357,6 +397,9 @@ class MasterWorker:
         await asyncio.to_thread(
             self.stream.call, self.cfg.trainer_handler, "exit"
         )
+        telemetry.shutdown()  # final master flush into the aggregator
+        if self._aggregator is not None:
+            self._aggregator.close()
         self._writer.close()
         self.ctrl.close()
         return {"steps": self.step, "stats": self._stats_history}

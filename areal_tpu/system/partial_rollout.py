@@ -21,13 +21,14 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from areal_tpu.api.model import GenerationHyperparameters
-from areal_tpu.base import logging
+from areal_tpu.base import logging, telemetry
 from areal_tpu.base.retry import (
     DEFAULT_GENERATION_RETRY,
     FaultInjector,
@@ -129,6 +130,19 @@ class PartialRolloutClient:
         gconfig: GenerationHyperparameters,
         eos_token_id: int = 1,
     ) -> GenResult:
+        with telemetry.span("rollout/generate") as span_attrs:
+            res = await self._generate_one(prompt_ids, gconfig, eos_token_id)
+            span_attrs["n_chunks"] = res.n_chunks
+            span_attrs["n_tokens"] = len(res.output_ids)
+            span_attrs["versions"] = [res.version_start, res.version_end]
+        return res
+
+    async def _generate_one(
+        self,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        eos_token_id: int = 1,
+    ) -> GenResult:
         acc_ids: List[int] = []
         acc_lps: List[float] = []
         version_start: Optional[int] = None
@@ -163,6 +177,7 @@ class PartialRolloutClient:
                     if self.faults is not None:
                         self.faults.maybe_fail("generate", url=url,
                                                tokens_done=len(acc_ids))
+                    t_chunk = time.monotonic()
                     async with self.session.post(f"{url}/generate",
                                                  json=body) as r:
                         if r.status != 200:
@@ -170,6 +185,8 @@ class PartialRolloutClient:
                                 f"/generate status {r.status}"
                             )
                         out = await r.json()
+                    telemetry.observe("rollout/chunk_secs",
+                                      time.monotonic() - t_chunk)
                 except asyncio.CancelledError:
                     raise
                 except NoHealthyServersError as e:
@@ -179,8 +196,10 @@ class PartialRolloutClient:
                     # gap. Poll on a separate, longer budget instead.
                     await self._release_quiet(route)
                     route = None
+                    telemetry.inc("rollout/no_server_503")
                     if fleet_waited >= self.no_server_wait_secs:
                         self.n_abandoned += 1
+                        telemetry.inc("rollout/abandoned")
                         raise GenerationAbandonedError(
                             f"no routable generation server for "
                             f"{fleet_waited:.0f}s "
@@ -195,12 +214,14 @@ class PartialRolloutClient:
                     route = None
                     if failures >= self.retry.max_attempts:
                         self.n_abandoned += 1
+                        telemetry.inc("rollout/abandoned")
                         raise GenerationAbandonedError(
                             f"generation abandoned after {failures} "
                             f"consecutive chunk failures "
                             f"({len(acc_ids)} tokens accumulated): {e}"
                         ) from e
                     self.n_failovers += 1
+                    telemetry.inc("rollout/chunk_failovers")
                     logger.warning(
                         f"chunk failed ({e}); re-scheduling "
                         f"(attempt {failures}/{self.retry.max_attempts}, "

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -20,7 +21,8 @@ import numpy as np
 import areal_tpu.agents  # noqa: F401 — registers built-in agents/envs
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import GenerationHyperparameters, make_agent
-from areal_tpu.base import logging, name_resolve, names
+from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.base import logging, name_resolve, names, telemetry
 from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl, load_shuffle_split
 from areal_tpu.base.retry import (
     DEFAULT_GENERATION_RETRY,
@@ -68,6 +70,11 @@ class RolloutWorkerConfig:
     # abandoned (clean /finish_rollout, worker stays alive).
     retry: RetryPolicy = dataclasses.field(
         default_factory=lambda: DEFAULT_GENERATION_RETRY
+    )
+    # Unified telemetry (base/telemetry.py): per-generation lifecycle
+    # spans, chunk-latency histograms, staleness lag. Off by default.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
     )
 
 
@@ -153,6 +160,7 @@ class RolloutWorker:
         # cancelled after the manager booked quota but before our
         # try/finally owns it, running_rollouts would leak forever. Shield
         # the RPC, and on cancellation let it complete and compensate.
+        t_alloc = time.monotonic()
         alloc_fut = asyncio.ensure_future(self._post_json(
             session, f"{mgr_url}/allocate_rollout",
             {"n_samples": cfg.group_size},
@@ -186,8 +194,14 @@ class RolloutWorker:
             await asyncio.sleep(1.0)
             return "retry"
         if not alloc.get("allowed"):
+            telemetry.inc("rollout/alloc_denied")
+            telemetry.inc(
+                f"rollout/alloc_denied_{alloc.get('reason', 'unknown')}"
+            )
             await asyncio.sleep(0.5)
             return "retry"
+        telemetry.observe("rollout/alloc_rpc_secs",
+                          time.monotonic() - t_alloc)
         accepted = 0
         abandoned = False
         task = None
@@ -233,8 +247,20 @@ class RolloutWorker:
             final = await task
             for t in final:
                 pusher.push(t.as_json_compatible())
+                if "version_start" in t.data:
+                    # Version-staleness lag at submit: how many weight
+                    # versions elapsed while this trajectory generated —
+                    # the decoupled-loss off-policyness the staleness gate
+                    # is supposed to bound.
+                    telemetry.observe(
+                        "rollout/staleness_lag",
+                        float(np.asarray(t.data["version_end"])[0]
+                              - np.asarray(t.data["version_start"])[0]),
+                        buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0),
+                    )
             accepted = len(final)
             self._pushed += accepted
+            telemetry.inc("rollout/trajectories_pushed", accepted)
         except GenerationAbandonedError as e:
             # The generation fleet stayed dead through the whole failover
             # budget. Abandon THIS rollout cleanly — the finally below
@@ -286,6 +312,11 @@ class RolloutWorker:
         from areal_tpu.system.worker_base import WorkerControl
 
         cfg = self.cfg
+        if cfg.telemetry.enabled:
+            telemetry.configure(
+                cfg.experiment, cfg.trial, "rollout", cfg.worker_index,
+                cfg.telemetry,
+            )
         ctrl = WorkerControl(
             cfg.experiment, cfg.trial, f"rollout{cfg.worker_index}"
         )
@@ -304,14 +335,22 @@ class RolloutWorker:
 
             async def one(rec, uid):
                 async with sem:
-                    # A denied allocation (staleness/capacity gate) must not
-                    # drop the prompt — retry until the gate opens.
-                    while True:
-                        status = await self._rollout_one(
-                            rec, uid, client, pusher, mgr_url, session
-                        )
-                        if status != "retry":
-                            break
+                    with telemetry.span("rollout/rollout", uid=uid) as attrs:
+                        # A denied allocation (staleness/capacity gate) must
+                        # not drop the prompt — retry until the gate opens.
+                        t0 = time.monotonic()
+                        while True:
+                            t_attempt = time.monotonic()
+                            status = await self._rollout_one(
+                                rec, uid, client, pusher, mgr_url, session
+                            )
+                            if status != "retry":
+                                break
+                        # Time blocked by the staleness/capacity gate (and
+                        # manager blips) before the successful attempt.
+                        telemetry.observe("rollout/alloc_wait_secs",
+                                          t_attempt - t0)
+                        attrs["status"] = status
                     if status == "ok":
                         self.consumed.add(uid)
 
@@ -328,6 +367,9 @@ class RolloutWorker:
                 )
                 if ctrl.should_exit:
                     break
+                telemetry.set_gauge("rollout/inflight", len(pending))
+                telemetry.set_gauge("rollout/done", self._done)
+                telemetry.set_gauge("rollout/failovers", client.n_failovers)
                 while len(pending) < cfg.max_concurrent:
                     rec = self.records[pos % len(self.records)]
                     # Epoch passes over a small dataset re-visit the same
@@ -353,6 +395,7 @@ class RolloutWorker:
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
         ctrl.close()
+        telemetry.shutdown()  # final flush to the aggregator
         logger.info(
             f"rollout worker done: {self._pushed} trajectories pushed "
             f"({self._abandoned} abandoned, "

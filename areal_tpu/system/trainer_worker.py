@@ -38,8 +38,8 @@ from areal_tpu.api.model import (
     make_dataset,
     make_interface,
 )
-from areal_tpu.api.train_config import WeightSyncConfig
-from areal_tpu.base import logging, name_resolve, names
+from areal_tpu.api.train_config import TelemetryConfig, WeightSyncConfig
+from areal_tpu.base import logging, name_resolve, names, telemetry
 from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
 
 logger = logging.getLogger("system.trainer")
@@ -87,6 +87,11 @@ class TrainerWorkerConfig:
     # streamed transport and threads it through here.
     weight_sync: WeightSyncConfig = dataclasses.field(
         default_factory=lambda: WeightSyncConfig(transport="disk")
+    )
+    # Unified telemetry (base/telemetry.py): step-phase spans, weight-sync
+    # latency gauges, profiler trigger. Off by default — zero overhead.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
     )
     # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
     # one per host — join one jax.distributed program; rank 0 owns every
@@ -200,6 +205,19 @@ class TrainerWorker:
             self._server = WorkerRequestServer(
                 cfg.experiment, cfg.trial, cfg.handler
             )
+        # Telemetry + profiler trigger: rank 0 only (it owns the control
+        # plane; follower ranks mirror its work anyway). With the config
+        # absent/disabled, configure() installs the no-op sink and no
+        # watcher is created — the serve loop pays nothing.
+        self._profiler = None
+        if cfg.telemetry.enabled and self._rank0:
+            telemetry.configure(
+                cfg.experiment, cfg.trial, "trainer", cfg.dist_rank,
+                cfg.telemetry,
+            )
+            self._profiler = telemetry.ProfilerTriggerWatcher(
+                cfg.experiment, cfg.trial
+            )
         logger.info(
             f"trainer up (rank {cfg.dist_rank}/{cfg.dist_world}): "
             f"models={list(self.models)} mfcs={list(self.interfaces)}"
@@ -253,7 +271,12 @@ class TrainerWorker:
             self.store[s.ids[0]] = s
 
     def _handle_fetch(self, p: Payload) -> Any:
-        batch = self._read_batch(int(p.data or self.cfg.batch_size))
+        with telemetry.span("trainer/data_wait",
+                            stream=self.cfg.stream_dataset) as attrs:
+            batch = self._read_batch(int(p.data or self.cfg.batch_size))
+            attrs["n_seqs"] = batch.bs if batch is not None else 0
+        telemetry.set_gauge("trainer/pull_queue_depth",
+                            self._pull_q.qsize())
         if batch is not None:
             # Every rank stores the same batch (multi-host: the jitted
             # steps consume identical replicated host inputs per process).
@@ -296,19 +319,21 @@ class TrainerWorker:
         for hook in p.pre_hooks:
             self._run_hook(hook)
         trace_dir = os.environ.get("AREAL_DUMP_TRACE")
-        if trace_dir:
-            # Env-gated per-MFC profiler (reference REAL_DUMP_TRACE,
-            # model_worker.py:829 __maybe_profile_rpc): one jax.profiler
-            # trace per MFC invocation, viewable in tensorboard/xprof.
-            import jax
+        with telemetry.span("trainer/mfc", mfc=mfc_name, method=method,
+                            n_seqs=batch.bs):
+            if trace_dir:
+                # Env-gated per-MFC profiler (reference REAL_DUMP_TRACE,
+                # model_worker.py:829 __maybe_profile_rpc): one jax.profiler
+                # trace per MFC invocation, viewable in tensorboard/xprof.
+                import jax
 
-            out_dir = os.path.join(
-                trace_dir, f"{mfc_name}_{model.version.global_step}"
-            )
-            with jax.profiler.trace(out_dir):
+                out_dir = os.path.join(
+                    trace_dir, f"{mfc_name}_{model.version.global_step}"
+                )
+                with jax.profiler.trace(out_dir):
+                    out = getattr(iface, method)(model, batch, mb_spec)
+            else:
                 out = getattr(iface, method)(model, batch, mb_spec)
-        else:
-            out = getattr(iface, method)(model, batch, mb_spec)
         result: Dict[str, Any] = {"stats": None, "meta": None}
         if method == "train_step":
             result["stats"] = out
@@ -408,8 +433,12 @@ class TrainerWorker:
         version = model.version.global_step
         path = os.path.join(self.cfg.realloc_dir, role, str(version))
         t0 = time.monotonic()
-        self._save_role(role, path, fmt="native")
+        with telemetry.span("trainer/weight_publish", role=role,
+                            version=version, transport="disk"):
+            self._save_role(role, path, fmt="native")
         save_secs = time.monotonic() - t0
+        telemetry.set_gauge("trainer/weight_publish_secs", save_secs)
+        telemetry.inc("trainer/weight_publishes")
         if not self._rank0:
             return
         # A crashed stream-mode predecessor may have left its endpoint in
@@ -455,8 +484,12 @@ class TrainerWorker:
         # publish() returns the moment the manifest is registered: the d2h
         # gather runs in the publisher's background thread, overlapping the
         # wire leg of tensors already gathered (and the servers' uploads).
-        pub.publish(sorted(flatten_pytree(params).items()), version)
+        with telemetry.span("trainer/weight_publish", role=role,
+                            version=version, transport="stream"):
+            pub.publish(sorted(flatten_pytree(params).items()), version)
         publish_secs = time.monotonic() - t0
+        telemetry.set_gauge("trainer/weight_publish_secs", publish_secs)
+        telemetry.inc("trainer/weight_publishes")
         self._bump_version(role, version, publish_secs)
         logger.info(
             f"published {role} weights v{version} -> {pub.endpoint} "
@@ -674,7 +707,12 @@ class TrainerWorker:
                 ctrl.step(lambda: {"roles": sorted(self.models)})
                 if ctrl.should_exit:
                     break
+                if self._profiler is not None:
+                    # Operator-requested jax.profiler capture (rate-limited
+                    # name-resolve poll; docs/observability.md).
+                    self._profiler.poll()
                 self.serve_once(timeout_ms=100)
+                telemetry.set_gauge("trainer/store_size", len(self.store))
             ctrl.close()
         else:
             while not self._exiting:
@@ -685,3 +723,4 @@ class TrainerWorker:
             self._puller.close()
         for pub in self._weight_publishers.values():
             pub.close()
+        telemetry.shutdown()  # final flush to the aggregator

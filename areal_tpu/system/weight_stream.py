@@ -56,7 +56,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 import zmq
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base import logging, name_resolve, names, network, telemetry
 
 logger = logging.getLogger("system.weight_stream")
 
@@ -217,6 +217,16 @@ class WeightStreamPublisher:
                 pub.ready[i].set()
             pub.gather_secs = time.monotonic() - t0
             pub.complete.set()
+            # d2h leg throughput for the unified telemetry stream (the
+            # trainer process owns this publisher).
+            total = float(sum(pub.nbytes))
+            telemetry.set_gauge("weight_stream/gather_secs",
+                                pub.gather_secs)
+            telemetry.set_gauge(
+                "weight_stream/gather_mb_per_sec",
+                (total / max(pub.gather_secs, 1e-9)) / (1 << 20),
+            )
+            telemetry.inc("weight_stream/published_bytes", total)
         except Exception as e:  # noqa: BLE001 — surfaced via chunk errors
             logger.error(f"weight gather v{pub.version} failed: {e}")
             with self._lock:
@@ -270,6 +280,7 @@ class WeightStreamPublisher:
                 raise _NotReady
             if pub.arrays[t] is None:  # gather failed
                 raise WeightStreamError("publisher gather failed")
+            telemetry.inc("weight_stream/chunks_served")
             return [
                 b"ok",
                 json.dumps({"version": version, "tensor": t, "chunk": c,

@@ -2,6 +2,15 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+AREAL_TELEMETRY=1 additionally enables the in-process telemetry registry
+(base/telemetry.py, no pusher/sockets) and emits the trainer step-phase
+breakdown — split_pack / fwd_bwd / optimizer seconds per timed step — as
+a "train_phases" field, so the BENCH trajectory records where each step's
+wall clock went instead of one opaque scalar. Telemetry stays OFF by
+default: the headline number always measures the uninstrumented path
+(enabling it adds a device sync between fwd-bwd and optimizer to make
+the split honest).
+
 Protocol (mirrors the reference's "effective trained tokens/sec",
 benchmark/verl_v0_3_0_post1_76084d3/README.md:27-34): time full PPO actor
 train steps — micro-batched forward+backward+optimizer over packed
@@ -13,6 +22,7 @@ absolute tokens/sec (BASELINE.md).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -23,6 +33,12 @@ import numpy as np  # noqa: E402
 
 
 def main():
+    from areal_tpu.base import telemetry
+
+    use_telemetry = os.environ.get("AREAL_TELEMETRY", "") not in ("", "0")
+    if use_telemetry:
+        # Local registry only — no aggregator exists here, so no pusher.
+        telemetry.configure("bench", "b0", "trainer", 0, push=False)
     from areal_tpu.algorithms.ppo import (
         PPOActorInterface,
         PPOHyperparameters,
@@ -99,12 +115,27 @@ def main():
 
     iface.train_step(model, batch, spec)  # warmup/compile
     jax.block_until_ready(model.module.params)
+    telemetry.get().snapshot(reset=True)  # drop warmup-step spans
     t0 = time.perf_counter()
     steps = 3
     for _ in range(steps):
         iface.train_step(model, batch, spec)
     jax.block_until_ready(model.module.params)
     dt = time.perf_counter() - t0
+
+    # Trainer step-phase breakdown from the timed steps' telemetry spans
+    # (backend/jax_train.py train_batch instrumentation).
+    train_phases = None
+    if use_telemetry:
+        spans = telemetry.get().snapshot(reset=True)["spans"]
+        agg = {}
+        for s in spans:
+            if s["name"].startswith("train/"):
+                agg[s["name"]] = agg.get(s["name"], 0.0) + s["dur_secs"]
+        train_phases = {
+            k.split("/", 1)[1] + "_s": round(v / steps, 4)
+            for k, v in sorted(agg.items())
+        }
 
     n_chips = jax.device_count()
     tokens_per_sec_chip = steps * total / dt / n_chips
@@ -180,7 +211,7 @@ def main():
     peak = next((v for k, v in peaks.items() if k in kind), None)
     mfu = (flops / dt / n_chips / peak) if peak else 0.0
 
-    print(json.dumps({
+    out = {
         "metric": "ppo_trained_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
@@ -193,7 +224,13 @@ def main():
         # round-trip (r5 measured disk io + d2h and extrapolated h2d as
         # 2× d2h). See docs/benchmarks.md for the discontinuity note.
         "weight_sync_transport_method": "streamed-measured",
-    }))
+    }
+    if train_phases is not None:
+        # Phase fields are a measurement-method ADDITION (AREAL_TELEMETRY=1
+        # runs only): phases sum to ~the per-step wall clock; the headline
+        # tokens/s stays defined by the uninstrumented default run.
+        out["train_phases"] = train_phases
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -26,6 +26,16 @@ from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
 
 EXP, TRIAL = "asyncppo", "t0"
 TINY = {"vocab_size": 258, "seed": 0}
+# Telemetry rides along on the full-loop e2e (docs/observability.md):
+# every worker kind pushes snapshots to the master's aggregator. Fast
+# flushes so the few-step run lands several snapshots per worker.
+TEL = {"enabled": True, "flush_interval_secs": 0.3}
+
+
+def _tel():
+    from areal_tpu.api.train_config import TelemetryConfig
+
+    return TelemetryConfig(**TEL)
 
 
 def _gen_fleet_main(nr_root, data_path, realloc_dir):
@@ -58,7 +68,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
         server = GenerationServer(
             GenerationServerConfig(
                 experiment=EXP, trial=TRIAL, chunk_tokens=4,
-                prompt_bucket=16, batch_window_ms=2,
+                prompt_bucket=16, batch_window_ms=2, telemetry=_tel(),
             ),
             cfg, params,
         )
@@ -66,7 +76,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
         mgr = GserverManager(GserverManagerConfig(
             experiment=EXP, trial=TRIAL, n_servers=1, train_batch_size=4,
             max_head_offpolicyness=4, realloc_dir=realloc_dir,
-            weight_poll_secs=0.2,
+            weight_poll_secs=0.2, telemetry=_tel(),
         ))
         await mgr.start()
         worker = RolloutWorker(RolloutWorkerConfig(
@@ -74,6 +84,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
             gconfig=GenerationHyperparameters(max_new_tokens=8),
             group_size=2, chunk_tokens=4, max_concurrent=4,
             tokenizer=MockTokenizer(), max_rollouts=None,
+            telemetry=_tel(),
         ))
         await worker.run_async()  # runs until killed
 
@@ -134,6 +145,7 @@ def _trainer_main(nr_root, realloc_dir):
         tokenizer=MockTokenizer(),
         stream_dataset=True,
         realloc_dir=realloc_dir,
+        telemetry=_tel(),
     )
     TrainerWorker(cfg).run()
 
@@ -175,6 +187,7 @@ def test_async_ppo_full_loop(tmp_path):
     nr_root = str(tmp_path / "nr")
     data_path = str(tmp_path / "math.jsonl")
     realloc_dir = str(tmp_path / "realloc")
+    jsonl_path = str(tmp_path / "telemetry.jsonl")
     make_math_jsonl(data_path, n=8)
     name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_root)
 
@@ -192,24 +205,55 @@ def test_async_ppo_full_loop(tmp_path):
             MasterWorkerConfig,
         )
 
+        import dataclasses as dc
+
         master = MasterWorker(
             MasterWorkerConfig(
                 experiment=EXP, trial=TRIAL, train_batch_size=8,
                 exp_ctrl=ExperimentSaveEvalControl(
                     total_train_epochs=10**6, benchmark_steps=3,
                 ),
+                telemetry=dc.replace(_tel(), jsonl_path=jsonl_path),
             ),
             _build_async_dfg(),
         )
+        from areal_tpu.base import names
+
         result = master.run()
         assert result["steps"] == 3
         losses = [s["actor_train/actor_loss"] for s in result["stats"]]
         assert all(np.isfinite(x) for x in losses)
         # the weight-sync circle closed: version reached ≥ 2
-        from areal_tpu.base import names
-
         v = int(name_resolve.get(names.model_version(EXP, TRIAL, "actor")))
         assert v >= 2
+        # --- unified telemetry landed (docs/observability.md) ---
+        # the aggregated jsonl carries spans/metrics from ≥ 3 worker kinds
+        import json as _json
+
+        with open(jsonl_path) as f:
+            recs = [_json.loads(ln) for ln in f if ln.strip()]
+        kinds = {r["worker"].split(":")[0] for r in recs}
+        assert len(kinds) >= 3, kinds
+        assert any(r["spans"] for r in recs)
+        # the generation server (fleet process still alive) serves valid
+        # Prometheus text with weight-version + inflight gauges
+        import urllib.request
+
+        (gurl,) = name_resolve.get_subtree(
+            names.gen_server_root(EXP, TRIAL)
+        )
+        with urllib.request.urlopen(f"{gurl}/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "# TYPE areal_genserver_weight_version gauge" in prom
+        assert "areal_genserver_weight_version{" in prom
+        assert "areal_genserver_inflight_requests{" in prom
+        for ln in prom.splitlines():  # every sample line parses
+            if ln and not ln.startswith("#"):
+                float(ln.rpartition(" ")[2])
+        murl = name_resolve.get(names.gen_server_manager(EXP, TRIAL))
+        with urllib.request.urlopen(f"{murl}/metrics", timeout=10) as r:
+            mprom = r.read().decode()
+        assert "areal_gsmgr_healthy_servers 1" in mprom
     finally:
         for p in (trainer, fleet):
             if p.is_alive():

@@ -1,0 +1,390 @@
+"""Unified telemetry layer (base/telemetry.py, docs/observability.md).
+
+All in-process fakes, zero real sleeps: pushers are flushed explicitly
+(``flush()``) instead of waiting out their interval, the aggregator is
+polled with short bounded waits, and the profiler watcher gets injected
+start/stop functions.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.base import name_resolve, names, telemetry
+
+pytestmark = pytest.mark.telemetry
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counters_gauges_histograms():
+    r = telemetry.TelemetryRegistry()
+    r.inc("a")
+    r.inc("a", 2.5)
+    r.set_gauge("g", 7)
+    r.set_gauge("g", 3)  # last write wins
+    r.observe("h", 0.02, buckets=(0.01, 0.1, 1.0))
+    r.observe("h", 0.5)
+    r.observe("h", 99.0)  # lands in the +Inf bucket
+    s = r.snapshot()
+    assert s["counters"]["a"] == 3.5
+    assert s["gauges"]["g"] == 3.0
+    h = s["hists"]["h"]
+    assert h["buckets"] == [0.01, 0.1, 1.0]
+    assert h["counts"] == [0, 1, 1, 1]
+    assert h["count"] == 3 and abs(h["sum"] - 99.52) < 1e-9
+    # metrics are CUMULATIVE: a draining snapshot does not reset them
+    r.snapshot(reset=True)
+    assert r.snapshot()["counters"]["a"] == 3.5
+
+
+def test_snapshot_reset_drains_only_spans():
+    r = telemetry.TelemetryRegistry()
+    with r.span("s"):
+        pass
+    r.inc("c")
+    s1 = r.snapshot(reset=True)
+    assert len(s1["spans"]) == 1
+    s2 = r.snapshot(reset=True)
+    assert s2["spans"] == [] and s2["counters"]["c"] == 1.0
+
+
+def test_span_nesting_parent_ids():
+    r = telemetry.TelemetryRegistry()
+    with r.span("outer", k="v") as attrs:
+        attrs["added"] = 1
+        with r.span("mid"):
+            with r.span("leaf"):
+                pass
+        with r.span("mid2"):
+            pass
+    spans = {s["name"]: s for s in r.snapshot()["spans"]}
+    assert spans["outer"]["parent_id"] is None
+    assert spans["mid"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["leaf"]["parent_id"] == spans["mid"]["span_id"]
+    assert spans["mid2"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["outer"]["attrs"] == {"k": "v", "added": 1}
+    assert spans["outer"]["dur_secs"] >= spans["mid"]["dur_secs"]
+    # every span also lands in a duration histogram
+    assert r.snapshot()["hists"]["outer/secs"]["count"] == 1
+
+
+def test_span_nesting_is_thread_local():
+    r = telemetry.TelemetryRegistry()
+    seen = {}
+
+    def worker():
+        with r.span("in_thread"):
+            pass
+        seen["done"] = True
+
+    with r.span("main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {s["name"]: s for s in r.snapshot()["spans"]}
+    # the thread's span must NOT inherit the main thread's open span
+    assert spans["in_thread"]["parent_id"] is None
+    assert seen["done"]
+
+
+def test_span_buffer_bounded():
+    r = telemetry.TelemetryRegistry(max_spans=4)
+    for i in range(10):
+        with r.span(f"s{i}"):
+            pass
+    s = r.snapshot()
+    assert len(s["spans"]) == 4
+    assert s["dropped_spans"] == 6
+    assert [x["name"] for x in s["spans"]] == ["s6", "s7", "s8", "s9"]
+
+
+# ---------------------------------------------------------------------------
+# disabled-by-default contract
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_default_is_noop():
+    telemetry.shutdown()
+    assert not telemetry.enabled()
+    sink = telemetry.get()
+    assert sink is telemetry.NULL
+    assert sink.registry is None and sink.pusher is None  # no sockets
+    # module API is callable and inert
+    telemetry.inc("x")
+    telemetry.set_gauge("y", 1)
+    telemetry.observe("z", 0.1)
+    with telemetry.span("s") as attrs:
+        assert attrs == {}
+    assert telemetry.get().snapshot()["counters"] == {}
+
+
+def test_configure_with_disabled_config_keeps_null(tmp_name_resolve):
+    out = telemetry.configure("e", "t", "trainer", 0,
+                              TelemetryConfig(enabled=False))
+    assert out is telemetry.NULL
+    assert not telemetry.enabled()
+    # no aggregator endpoint, no pusher socket was created
+    with pytest.raises(Exception):
+        name_resolve.get(names.telemetry_aggregator("e", "t"))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_rendering():
+    r = telemetry.TelemetryRegistry()
+    r.inc("reqs.ok", 5)
+    r.set_gauge("queue/depth", 2)
+    r.observe("lat", 0.3, buckets=(0.1, 1.0))
+    r.observe("lat", 5.0)
+    text = telemetry.render_prometheus(
+        r.snapshot(),
+        extra_gauges={"weight_version": 3, "skipped_none": None,
+                      "skipped_str": "nope"},
+        labels={"server_id": "gen0"},
+    )
+    lines = text.splitlines()
+    assert '# TYPE areal_weight_version gauge' in lines
+    assert 'areal_weight_version{server_id="gen0"} 3' in lines
+    assert '# TYPE areal_reqs_ok_total counter' in lines
+    assert 'areal_reqs_ok_total{server_id="gen0"} 5' in lines
+    assert 'areal_queue_depth{server_id="gen0"} 2' in lines
+    # histogram: cumulative buckets, +Inf, sum, count
+    assert 'areal_lat_bucket{le="0.1",server_id="gen0"} 0' in lines
+    assert 'areal_lat_bucket{le="1",server_id="gen0"} 1' in lines
+    assert 'areal_lat_bucket{le="+Inf",server_id="gen0"} 2' in lines
+    assert 'areal_lat_sum{server_id="gen0"} 5.3' in lines
+    assert 'areal_lat_count{server_id="gen0"} 2' in lines
+    # nothing for the unrepresentable extra gauges
+    assert "skipped" not in text
+    # every sample line is "name{labels} value"
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        float(val)
+        assert name and " " not in name
+
+
+# ---------------------------------------------------------------------------
+# aggregator merge across fake workers
+# ---------------------------------------------------------------------------
+
+
+def _wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_aggregator_merges_fake_workers(tmp_name_resolve, tmp_path):
+    jsonl = str(tmp_path / "telemetry.jsonl")
+    agg = telemetry.TelemetryAggregator("e", "t", jsonl_path=jsonl)
+    pushers = []
+    try:
+        for kind, idx in [("trainer", 0), ("rollout", 0), ("rollout", 1),
+                          ("gserver_manager", 0)]:
+            reg = telemetry.TelemetryRegistry()
+            reg.inc(f"{kind}/work", idx + 1)
+            reg.set_gauge("up", 1)
+            with reg.span(f"{kind}/step"):
+                pass
+            # Huge interval: the thread never fires on its own; we flush
+            # explicitly (zero real sleeps in the push path).
+            p = telemetry.TelemetryPusher(reg, "e", "t", kind, idx,
+                                          flush_interval_secs=3600)
+            assert p.flush()
+            pushers.append(p)
+        assert _wait_until(lambda: len(agg.state) == 4)
+        merged = agg.merged()
+        assert set(merged) == {"trainer:0", "rollout:0", "rollout:1",
+                               "gserver_manager:0"}
+        assert merged["rollout:1"]["counters"]["rollout/work"] == 2.0
+        assert merged["trainer:0"]["n_spans"] == 1
+        # second flush from one worker UPDATES its key (no duplication)
+        pushers[0].registry.inc("trainer/work")
+        assert pushers[0].flush()
+        assert _wait_until(
+            lambda: agg.merged()["trainer:0"]["counters"]["trainer/work"]
+            == 2.0
+        )
+        assert len(agg.merged()) == 4
+    finally:
+        for p in pushers:
+            p.close()
+        agg.close()
+    # jsonl: one line per received snapshot, each tagged with its worker
+    with open(jsonl) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(recs) >= 5
+    kinds = {r["worker"].split(":")[0] for r in recs}
+    assert {"trainer", "rollout", "gserver_manager"} <= kinds
+    span_recs = [r for r in recs if r["spans"]]
+    assert span_recs and all("dur_secs" in s for r in span_recs
+                             for s in r["spans"])
+    # merged fleet view renders as labeled Prometheus text
+    # (endpoint deregistered on close, but rendering is pure)
+
+
+def test_aggregator_prometheus_view(tmp_name_resolve):
+    agg = telemetry.TelemetryAggregator("e2", "t", jsonl_path=None)
+    pushers = []
+    try:
+        for idx in (0, 1):
+            reg = telemetry.TelemetryRegistry()
+            reg.set_gauge("depth", 4 + idx)
+            reg.observe("lat", 0.2)
+            p = telemetry.TelemetryPusher(reg, "e2", "t", "rollout", idx,
+                                          flush_interval_secs=3600)
+            assert p.flush()
+            pushers.append(p)
+        assert _wait_until(lambda: len(agg.state) == 2)
+        text = agg.render_prometheus()
+        assert 'areal_depth{worker_index="0",worker_kind="rollout"} 4' \
+            in text
+        assert 'areal_depth{worker_index="1",worker_kind="rollout"} 5' \
+            in text
+        # one exposition: same-family samples from both workers share ONE
+        # TYPE line (expfmt consumers reject duplicate TYPE lines)
+        assert text.count("# TYPE areal_depth gauge") == 1
+        assert text.count("# TYPE areal_lat histogram") == 1
+        lines = text.splitlines()
+        i = lines.index("# TYPE areal_depth gauge")
+        assert lines[i + 1].startswith("areal_depth{")
+        assert lines[i + 2].startswith("areal_depth{")
+    finally:
+        for p in pushers:
+            p.close()
+        agg.close()
+
+
+def test_pusher_backlog_preserves_spans(tmp_name_resolve):
+    """A backlogged aggregator (PUSH queue full → zmq.Again) must not
+    lose spans: the unsent snapshot is retained and the registry is not
+    drained again until it goes out."""
+    name_resolve.add(names.telemetry_aggregator("bk", "t"),
+                     "tcp://127.0.0.1:1")  # nobody listening: queue fills
+    reg = telemetry.TelemetryRegistry()
+    p = telemetry.TelemetryPusher(reg, "bk", "t", "trainer", 0,
+                                  flush_interval_secs=3600)
+    ok = True
+    for i in range(200):
+        with reg.span(f"s{i}"):
+            pass
+        ok = p.flush()
+        if not ok:
+            break
+    assert not ok, "send queue never filled"
+    assert p._pending is not None  # the failed snapshot is retained
+    with reg.span("kept"):
+        pass
+    assert p.flush() is False  # still backlogged: registry NOT drained
+    snap = reg.snapshot(reset=False)
+    assert any(s["name"] == "kept" for s in snap["spans"])
+    p.close()
+
+
+def test_pusher_without_aggregator_is_lossless_noop(tmp_name_resolve):
+    """No aggregator registered: flush() reports False and nothing
+    raises; metrics keep accumulating locally."""
+    reg = telemetry.TelemetryRegistry()
+    p = telemetry.TelemetryPusher(reg, "nowhere", "t", "trainer", 0,
+                                  flush_interval_secs=3600)
+    reg.inc("c")
+    assert p.flush() is False
+    assert reg.snapshot()["counters"]["c"] == 1.0
+    p.close()
+
+
+# ---------------------------------------------------------------------------
+# profiler-trigger plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_trigger_roundtrip(tmp_name_resolve, tmp_path):
+    calls = []
+    w = telemetry.ProfilerTriggerWatcher(
+        "e", "t", poll_secs=0.0,
+        start_fn=lambda d: calls.append(("start", d)),
+        stop_fn=lambda: calls.append(("stop",)),
+    )
+    w.poll()  # no trigger pending: no-op
+    assert calls == [] and not w.capturing
+    out = str(tmp_path / "prof")
+    telemetry.request_profiler_capture("e", "t", out, secs=0.0)
+    w.poll()  # picks up the trigger, starts the capture
+    assert calls == [("start", out)] and w.capturing
+    st = telemetry.read_profiler_status("e", "t")
+    assert st["state"] == "capturing" and st["dir"] == out
+    # the trigger was consumed exactly once
+    with pytest.raises(Exception):
+        name_resolve.get(names.profiler_trigger("e", "t"))
+    w.poll()  # secs=0: the window already elapsed → stop + status
+    assert calls[-1] == ("stop",) and not w.capturing
+    assert telemetry.read_profiler_status("e", "t")["state"] == "done"
+
+
+def test_profiler_trigger_failure_reports_status(tmp_name_resolve, tmp_path):
+    def boom(d):
+        raise RuntimeError("no profiler here")
+
+    w = telemetry.ProfilerTriggerWatcher("e", "t", poll_secs=0.0,
+                                         start_fn=boom,
+                                         stop_fn=lambda: None)
+    telemetry.request_profiler_capture("e", "t", str(tmp_path), secs=1.0)
+    w.poll()
+    st = telemetry.read_profiler_status("e", "t")
+    assert st["state"] == "failed" and "no profiler" in st["error"]
+    assert not w.capturing  # watcher stays usable for the next trigger
+
+
+# ---------------------------------------------------------------------------
+# thread-safe StatsTracker (satellite: export vs concurrent recording)
+# ---------------------------------------------------------------------------
+
+
+def test_stats_tracker_concurrent_export():
+    from areal_tpu.base.stats_tracker import StatsTracker
+
+    tr = StatsTracker()
+    stop = threading.Event()
+    errors = []
+
+    def record():
+        i = 0
+        while not stop.is_set():
+            try:
+                with tr.scope("w"):
+                    tr.scalar(x=float(i))
+                    tr.moving_avg(y=float(i))
+                i += 1
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=record) for _ in range(4)]
+    for t in threads:
+        t.start()
+    total = 0
+    for _ in range(200):
+        out = tr.export(reset=True)
+        # scoped keys never tear across threads (thread-local scope stack)
+        assert all(k.startswith("w/") for k in out)
+        total += len(out)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert total > 0

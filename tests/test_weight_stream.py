@@ -261,7 +261,7 @@ def test_atomic_swap_under_concurrent_generate(tmp_name_resolve):
                         assert r.status == 200
                         assert (await r.json())["version"] == 1
 
-                async with sess.get(f"{url}/metrics") as r:
+                async with sess.get(f"{url}/metrics.json") as r:
                     assert (await r.json())["version"] == 0
                 upd = asyncio.create_task(update())
                 # keep /generate traffic flowing until the swap landed AND
@@ -277,7 +277,7 @@ def test_atomic_swap_under_concurrent_generate(tmp_name_resolve):
                 await upd
                 assert set(versions) <= {0, 1}  # never a torn in-between
                 assert versions[-1] == 1  # post-swap traffic sees v1
-                async with sess.get(f"{url}/metrics") as r:
+                async with sess.get(f"{url}/metrics.json") as r:
                     assert (await r.json())["version"] == 1
             # swapped weights match the published tree bit-exactly
             for k, v in flatten_pytree(new_params, as_numpy=True).items():
@@ -311,7 +311,7 @@ def test_failed_stream_keeps_old_weights_and_500s(tmp_name_resolve):
                     assert r.status == 500
                     body = await r.json()
                     assert body["ok"] is False and body["version"] == 0
-                async with sess.get(f"{url}/metrics") as r:
+                async with sess.get(f"{url}/metrics.json") as r:
                     assert (await r.json())["version"] == 0
             after = flatten_pytree(server.params, as_numpy=True)
             for k in before:

@@ -2,7 +2,16 @@
 dispatch/packing overhead and sweeps the knobs that plausibly gate MFU.
 
 Usage: python tools/perf_probe.py [probe ...]
-Probes: e2e, grad, mbsweep, remat, trace  (default: e2e grad)
+Probes: e2e, grad, phases, mbsweep, remat, trace  (default: e2e grad)
+
+Live-fleet commands (docs/observability.md; name-resolve root via
+AREAL_NAME_RESOLVE_ROOT when not the default):
+  scrape <url>                        GET a worker's /metrics (Prometheus
+                                      text or JSON) and pretty-print it
+  profile-trigger <exp> <trial> <dir> [secs]
+                                      ask the live trainer for an
+                                      on-demand jax.profiler capture
+  profile-status <exp> <trial>        last capture outcome
 
 Writes findings to stdout; `trace` saves a jax.profiler trace under
 profiles/ for offline inspection.
@@ -12,6 +21,91 @@ import sys
 import time
 
 sys.path.insert(0, ".")
+
+
+def scrape(url: str) -> None:
+    """Fetch + pretty-print a worker's /metrics endpoint. Prometheus text
+    renders as an aligned table (histograms summarized as count/mean);
+    JSON (e.g. /metrics.json) pretty-prints as-is."""
+    import json as _json
+    import urllib.request
+
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    if "/metrics" not in url:
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read().decode()
+    if "json" in ctype:
+        print(_json.dumps(_json.loads(body), indent=2, sort_keys=True))
+        return
+    rows = []
+    hist = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        base, _, labels = name.partition("{")
+        labels = ("{" + labels) if labels else ""
+        # Key histograms by (family, labels): the master's merged endpoint
+        # carries one series per worker — dropping labels would silently
+        # overwrite worker 0's sum/count with worker 1's.
+        if base.endswith("_sum"):
+            hist.setdefault(base[:-4] + labels, {})["sum"] = float(val)
+        elif base.endswith("_count"):
+            hist.setdefault(base[:-6] + labels, {})["count"] = float(val)
+        elif base.endswith("_bucket"):
+            continue  # summarized via _sum/_count
+        else:
+            rows.append((base + labels, float(val)))
+    for h, d in sorted(hist.items()):
+        n = d.get("count", 0)
+        mean = (d.get("sum", 0.0) / n) if n else 0.0
+        rows.append((f"{h} (hist)", f"n={n:g} mean={mean:.4g}"))
+    w = max((len(r[0]) for r in rows), default=0)
+    for k, v in sorted(rows):
+        print(f"  {k:<{w}}  {v if isinstance(v, str) else f'{v:g}'}")
+
+
+def profile_trigger(experiment: str, trial: str, out_dir: str,
+                    secs: float = 5.0) -> None:
+    from areal_tpu.base import telemetry
+
+    telemetry.request_profiler_capture(experiment, trial, out_dir, secs)
+    print(f"profiler trigger set for {experiment}/{trial}: "
+          f"{secs}s -> {out_dir} (trainer picks it up within ~1s; check "
+          f"with `profile-status {experiment} {trial}`)")
+
+
+def profile_status(experiment: str, trial: str) -> None:
+    from areal_tpu.base import telemetry
+
+    st = telemetry.read_profiler_status(experiment, trial)
+    print(st if st is not None else "no capture recorded")
+
+
+def _dispatch_fleet_commands(argv) -> bool:
+    if not argv or argv[0] not in ("scrape", "profile-trigger",
+                                   "profile-status"):
+        return False
+    cmd = argv[0]
+    try:
+        if cmd == "scrape":
+            scrape(argv[1])
+        elif cmd == "profile-trigger":
+            profile_trigger(argv[1], argv[2], argv[3],
+                            float(argv[4]) if len(argv) > 4 else 5.0)
+        elif cmd == "profile-status":
+            profile_status(argv[1], argv[2])
+    except IndexError:
+        print(f"missing operand for {cmd!r}\n\n{__doc__}", file=sys.stderr)
+        sys.exit(1)
+    return True
+
+
+if _dispatch_fleet_commands(sys.argv[1:]):
+    sys.exit(0)
 
 import jax
 import jax.numpy as jnp
