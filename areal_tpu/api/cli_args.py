@@ -34,6 +34,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 from areal_tpu.api.train_config import (  # noqa: F401
     ExperimentSaveEvalControl,
     OptimizerConfig,
+    ServingConfig,
     TelemetryConfig,
     WeightSyncConfig,
 )
@@ -202,6 +203,11 @@ class BaseExperimentConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Generation-fleet serving engine (docs/serving.md): off by default —
+    # `serving.enabled=true` turns on request-class admission control,
+    # cross-request prefix-reuse KV, bounded compile-shape bucketing, and
+    # per-class latency SLO histograms on the generation servers.
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10
     # Test-only: use the deterministic mock tokenizer instead of HF.
@@ -350,6 +356,57 @@ def apply_overrides(cfg, overrides: List[str]):
         key, _, value = ov.partition("=")
         _set_dotted(cfg, key.strip(), value.strip())
     return cfg
+
+
+# Launch modes this framework implements. "ray" is descoped (VERDICT #10):
+# Ray is not in the TPU image, and the scheduler surface is SlurmClient +
+# LocalLauncher — see docs/operations.md §Launching.
+VALID_MODES = ("local", "slurm")
+
+
+def validate_config(cfg) -> None:
+    """Config-parse-time sanity checks, called right after overrides/YAML
+    merge (training/_cli.py) and again by the launcher: a bad ``mode``
+    must fail while the operator is still at the command line, not after
+    workers have been spawned."""
+    mode = getattr(cfg, "mode", "local")
+    if mode == "ray":
+        raise ConfigError(
+            "mode='ray' is descoped: Ray is not in the TPU image and there "
+            "is no Ray scheduler backend. Use mode=local (single host) or "
+            "mode=slurm (cluster) — see docs/operations.md §Launching. A "
+            "Ray backend would slot in at apps/launcher.py:run_experiment."
+        )
+    if mode not in VALID_MODES:
+        raise ConfigError(
+            f"mode={mode!r} is not supported: valid modes are "
+            f"{', '.join(VALID_MODES)} (docs/operations.md §Launching)"
+        )
+    serving = getattr(cfg, "serving", None)
+    if serving is not None and getattr(serving, "enabled", False):
+        # Bad serving bucket lists raise ValueError inside every spawned
+        # generation server's __init__; surface them while the operator
+        # is still at the command line. policy_from_config is pure
+        # bookkeeping (no jax), and experiment_policy_kwargs is the SAME
+        # experiment->policy mapping the async experiment wiring feeds
+        # into GenerationServerConfig — so this is the exact construction
+        # the servers will run, by sharing code rather than replicating
+        # the numbers.
+        from areal_tpu.system.serving import (
+            experiment_policy_kwargs,
+            policy_from_config,
+        )
+
+        try:
+            policy_from_config(serving, **experiment_policy_kwargs(cfg))
+        except ValueError as e:
+            raise ConfigError(f"invalid serving config: {e}") from None
+        share = float(getattr(serving, "min_rollout_share", 0.0))
+        if not 0.0 <= share <= 1.0:
+            raise ConfigError(
+                f"serving.min_rollout_share={share} must be in [0, 1] "
+                f"(fraction of each batch reserved for rollout traffic)"
+            )
 
 
 def merge_dict(cfg, d: Dict[str, Any], _path: str = ""):

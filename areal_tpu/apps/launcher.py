@@ -436,16 +436,17 @@ def run_experiment(exp_cfg) -> Dict[str, Any]:
     worker dies, up to ``recover_retries`` times — the reference's
     launcher-level restart loop (``realhf/apps/main.py:118-180``).
     """
+    # Belt-and-braces re-validation (training/_cli.py already validates at
+    # parse time): programmatic callers get the same clear error for the
+    # descoped mode=ray instead of a bare NotImplementedError.
+    from areal_tpu.api.cli_args import validate_config
+
+    validate_config(exp_cfg)
     mode = getattr(exp_cfg, "mode", "local")
     if mode == "slurm":
         from areal_tpu.apps.slurm import SlurmLauncher
 
         return SlurmLauncher(exp_cfg).run()
-    if mode != "local":
-        raise NotImplementedError(
-            f"mode={mode!r}: 'local' (single-host) and 'slurm' (cluster) "
-            "are implemented"
-        )
     recover_mode = getattr(exp_cfg, "recover_mode", "disabled")
     retries = (
         getattr(exp_cfg, "recover_retries", 1)

@@ -18,6 +18,7 @@ from typing import Any, Dict
 from areal_tpu.experiments import register_experiment
 from areal_tpu.experiments import common as C
 from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+from areal_tpu.system import serving
 
 
 @dataclasses.dataclass
@@ -47,15 +48,22 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             # the gen spec shard each server's decode over its slice.
             n_gen = alloc.gen_spec.data_degree
         paths = C.experiment_paths(self)
+        # The shared experiment->policy mapping (system/serving.py): the
+        # SAME kwargs cli_args.validate_config already front-ran at parse
+        # time, so the spawned servers construct exactly the validated
+        # shape policy.
+        shape_kw = serving.experiment_policy_kwargs(self)
         gen_servers = [
             GenerationServerConfig(
                 experiment=self.experiment_name, trial=self.trial_name,
                 server_id=f"gen{i}",
-                chunk_tokens=self.new_tokens_per_chunk,
+                chunk_tokens=shape_kw["chunk_tokens"],
                 batch_window_ms=self.gen_batch_window_ms,
-                max_batch_size=self.gen_max_batch_size,
-                prompt_bucket=self.gen_prompt_bucket,
+                max_batch_size=shape_kw["max_batch_size"],
+                prompt_bucket=shape_kw["prompt_bucket"],
+                kv_bucket=shape_kw["kv_bucket"],
                 weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
+                serving=self.serving,
                 telemetry=self.telemetry,
             )
             for i in range(n_gen)

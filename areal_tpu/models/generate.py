@@ -256,8 +256,16 @@ def decode_chunk_rows(
         now_finished = finished | (token == eos_token_id)
         cur_len = jnp.where(finished, cur_len, cur_len + 1)
         done = done + (~finished).astype(jnp.int32)
+        # Freeze last_logits once a row is finished: later steps feed pad
+        # tokens, and a retained state (serving-mode row_budget truncation)
+        # must carry the logits after its last REAL token — a continuation
+        # or a full-match prefix clone samples its next token from them.
+        last_logits = jnp.where(
+            finished[:, None], last_logits,
+            logits_step[:, 0].astype(jnp.float32),
+        )
         return (
-            kv["k"], kv["v"], logits_step[:, 0].astype(jnp.float32),
+            kv["k"], kv["v"], last_logits,
             cur_len, done, now_finished, key,
         ), (token, logprob, finished)
 
@@ -302,6 +310,76 @@ def decode_chunk(
         n_tokens=n_tokens, eos_token_id=eos_token_id,
         pad_token_id=pad_token_id,
     )
+
+
+def clone_prefix(state: Dict[str, jnp.ndarray], L) -> Dict[str, jnp.ndarray]:
+    """A decode state truncated to its first ``L`` tokens.
+
+    The compact KV layout (slot j holds token j) makes this free: the KV
+    arrays are shared as-is (jax arrays are immutable; slots ≥ L are
+    masked out by every downstream ``kv_valid``), only ``cur_len`` drops
+    to L. The cross-request prefix-seeding primitive: clone a donor's
+    retained state at the shared-prefix length, then
+    :func:`extend_state` the unshared suffix. ``last_logits`` is the
+    donor's (stale for L < donor length) — callers must extend with ≥ 1
+    token unless L equals the donor's full length.
+    """
+    return {
+        "kv_k": state["kv_k"],
+        "kv_v": state["kv_v"],
+        "last_logits": state["last_logits"],
+        "cur_len": jnp.full_like(state["cur_len"], L),
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"))
+def extend_state(
+    params,
+    cfg: TransformerConfig,
+    state: Dict[str, jnp.ndarray],
+    tokens: jnp.ndarray,  # [B, T] suffix, right-padded with pad tokens
+    token_lens: jnp.ndarray,  # [B] real suffix lengths (≥ 1)
+    attn_impl: str = "auto",
+) -> Dict[str, jnp.ndarray]:
+    """Teacher-force ``tokens`` through the model on top of an existing
+    decode state — the suffix prefill of cross-request prefix seeding: a
+    request whose prompt extends a retained state's tokens only pays
+    forward passes for the unshared suffix, not the whole prompt.
+
+    KV capacity must satisfy ``S ≥ max(cur_len + T)``. Slots written by
+    the padding tail hold garbage K/V but sit at positions ≥ the new
+    ``cur_len``: every later attention masks them (``slot ≤ pos``) until
+    decode overwrites them one step at a time.
+    """
+    B, T = tokens.shape
+    S = state["kv_k"].shape[2]
+    cur = state["cur_len"].astype(jnp.int32)
+    positions = cur[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    slot_ids = jnp.arange(S)
+    # Causal over the compact layout: suffix token t of row b attends
+    # slots j ≤ cur[b] + t (its own slot included — written above before
+    # attention — but never its padded/future siblings).
+    kv_valid = slot_ids[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    if cfg.sliding_window is not None:
+        kv_valid = kv_valid & (
+            (positions[:, :, None] - slot_ids[None, None, :])
+            < cfg.sliding_window
+        )
+    logits, kv = forward(
+        params, cfg, tokens, positions,
+        kv_cache={"k": state["kv_k"], "v": state["kv_v"]},
+        cache_write_index=cur, kv_valid=kv_valid, attn_impl=attn_impl,
+    )
+    last_idx = jnp.maximum(token_lens - 1, 0)
+    last_logits = jnp.take_along_axis(
+        logits, last_idx[:, None, None], axis=1
+    )[:, 0]
+    return {
+        "kv_k": kv["k"],
+        "kv_v": kv["v"],
+        "last_logits": last_logits.astype(jnp.float32),
+        "cur_len": cur + token_lens.astype(jnp.int32),
+    }
 
 
 def grow_state(state: Dict[str, jnp.ndarray], new_S: int) -> Dict[str, jnp.ndarray]:

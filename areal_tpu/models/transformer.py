@@ -223,10 +223,17 @@ def _block(
         k_cache, v_cache = cache_kv
         if getattr(cache_write_index, "ndim", 0) == 1:
             # Per-row write slots (continuous batching: rows of the batch
-            # sit at different sequence lengths). T must be 1.
+            # sit at different sequence lengths).
             rows = jnp.arange(B)
-            k_cache = k_cache.at[rows, cache_write_index].set(k[:, 0])
-            v_cache = v_cache.at[rows, cache_write_index].set(v[:, 0])
+            if T == 1:
+                k_cache = k_cache.at[rows, cache_write_index].set(k[:, 0])
+                v_cache = v_cache.at[rows, cache_write_index].set(v[:, 0])
+            else:
+                # Multi-token extension (prefix seeding): row b's T new
+                # tokens land in slots cache_write_index[b] .. +T.
+                idx = cache_write_index[:, None] + jnp.arange(T)[None, :]
+                k_cache = k_cache.at[rows[:, None], idx].set(k)
+                v_cache = v_cache.at[rows[:, None], idx].set(v)
         else:
             k_cache = jax.lax.dynamic_update_slice_in_dim(
                 k_cache, k, cache_write_index, axis=1

@@ -130,10 +130,17 @@ def packed_attention(
 
 
 def decode_attention(
-    q: jnp.ndarray,  # [B, 1, Hq, D] — current step
+    q: jnp.ndarray,  # [B, T, Hq, D] — current step(s); T > 1 = extension
     k_cache: jnp.ndarray,  # [B, S, Hkv, D]
     v_cache: jnp.ndarray,  # [B, S, Hkv, D]
-    kv_valid: jnp.ndarray,  # [B, S] bool — which cache slots are real tokens
+    kv_valid: jnp.ndarray,  # [B, S] bool — or [B, T, S] per-query-token
 ) -> jnp.ndarray:
-    mask = kv_valid[:, None, None, :]  # [B, 1, 1, S]
+    # A [B, T, S] kv_valid gives each of the T new tokens its own valid
+    # set — the causal mask of a multi-token cache extension (prefix
+    # seeding, models/generate.extend_state). [B, S] broadcasts the same
+    # set over every query token (the single-step decode path).
+    if kv_valid.ndim == 3:
+        mask = kv_valid[:, None, :, :]  # [B, 1, T, S]
+    else:
+        mask = kv_valid[:, None, None, :]  # [B, 1, 1, S]
     return attention_reference(q, k_cache, v_cache, mask)

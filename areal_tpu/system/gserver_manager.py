@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 from areal_tpu.api.train_config import TelemetryConfig
 from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.base.retry import FaultInjector, RetryPolicy, aretry
+from areal_tpu.system.serving import REQUEST_CLASSES, normalize_class
 
 logger = logging.getLogger("system.gserver_mgr")
 
@@ -102,6 +103,12 @@ class GserverManager:
         self._rr = 0
         self._inflight: Dict[str, int] = {}  # url -> outstanding requests
         self._leases: Dict[str, tuple] = {}  # lease_id -> (url, expires_at)
+        # Class-aware routing (docs/serving.md): leases carry a request
+        # class so one fleet serves rollout AND interactive/eval traffic
+        # with per-class load accounting. Kept out of the lease tuple so
+        # existing (url, expires) consumers stay untouched.
+        self._lease_class: Dict[str, str] = {}  # lease_id -> class
+        self._inflight_cls: Dict[str, Dict[str, int]] = {}  # url -> cls -> n
         self._lease_seq = 0
         # Both staleness terms are counted in SAMPLE units (the reference's
         # is_staled compares against train_batch_size samples): a rollout
@@ -154,9 +161,11 @@ class GserverManager:
         if url in self.servers:
             self.servers.remove(url)
         self._inflight.pop(url, None)
+        self._inflight_cls.pop(url, None)
         dropped = [lid for lid, (u, _) in self._leases.items() if u == url]
         for lid in dropped:
             del self._leases[lid]
+            self._lease_class.pop(lid, None)
         self.telemetry.inc("gsmgr/evictions")
         # The last probe/push failure is the actionable detail (connection
         # refused vs timeout vs bad status) — the reason alone often only
@@ -340,6 +349,11 @@ class GserverManager:
         t.set_gauge("gsmgr/running_rollouts", self.running_rollouts)
         t.set_gauge("gsmgr/accepted_rollouts", self.accepted_rollouts)
         t.set_gauge("gsmgr/weight_version", self.version)
+        for c in REQUEST_CLASSES:
+            t.set_gauge(
+                f"gsmgr/inflight_{c}",
+                sum(by.get(c, 0) for by in self._inflight_cls.values()),
+            )
         if self.last_sync_fanout_secs is not None:
             t.set_gauge("gsmgr/weight_sync_fanout_secs",
                         self.last_sync_fanout_secs)
@@ -365,6 +379,12 @@ class GserverManager:
 
     # ---------------- scheduling ----------------
 
+    def _drop_lease_class(self, lid: str, url: str) -> None:
+        cls = self._lease_class.pop(lid, "rollout")
+        by = self._inflight_cls.get(url)
+        if by and by.get(cls, 0) > 0:
+            by[cls] -= 1
+
     def _expire_leases(self) -> None:
         now = time.monotonic()
         dead = [lid for lid, (_, exp) in self._leases.items() if exp < now]
@@ -372,12 +392,29 @@ class GserverManager:
             url, _ = self._leases.pop(lid)
             if self._inflight.get(url, 0) > 0:
                 self._inflight[url] -= 1
+            self._drop_lease_class(lid, url)
             logger.warning(f"lease {lid} on {url} expired (client gone?)")
 
-    def _pick_server(self) -> Optional[str]:
+    def _cls_inflight(self, url: str, classes) -> int:
+        by = self._inflight_cls.get(url, {})
+        return sum(by.get(c, 0) for c in classes)
+
+    def _pick_server(self, cls: str = "rollout") -> Optional[str]:
         self._expire_leases()
         if not self.servers:
             return None
+        if cls != "rollout":
+            # Latency-sensitive classes route to the server carrying the
+            # least interactive+eval load (total inflight tie-breaks) —
+            # bulk rollout traffic keeps its configured policy, so one
+            # fleet serves both without the bulk queue burying the SLOs.
+            return min(
+                self.servers,
+                key=lambda u: (
+                    self._cls_inflight(u, ("interactive", "eval")),
+                    self._inflight.get(u, 0),
+                ),
+            )
         if self.cfg.schedule_policy == "least_requests":
             return min(self.servers, key=lambda u: self._inflight.get(u, 0))
         url = self.servers[self._rr % len(self.servers)]
@@ -395,7 +432,12 @@ class GserverManager:
     async def handle_schedule_request(self, request):
         from aiohttp import web
 
-        url = self._pick_server()
+        try:
+            d = await request.json()
+        except Exception:  # noqa: BLE001 — empty body = legacy client
+            d = {}
+        cls = normalize_class(d.get("class"))
+        url = self._pick_server(cls)
         if url is None:
             # Whole fleet evicted/dead: clients back off and retry — the
             # health loop re-admits servers as they recover.
@@ -408,8 +450,14 @@ class GserverManager:
         self._leases[lease_id] = (
             url, time.monotonic() + self.cfg.lease_ttl_secs
         )
+        self._lease_class[lease_id] = cls
+        self._inflight_cls.setdefault(url, {})
+        self._inflight_cls[url][cls] = \
+            self._inflight_cls[url].get(cls, 0) + 1
+        self.telemetry.inc(f"gsmgr/scheduled_{cls}")
         return web.json_response({
             "url": url, "version": self.version, "lease_id": lease_id,
+            "class": cls,
         })
 
     async def handle_renew(self, request):
@@ -435,6 +483,7 @@ class GserverManager:
                 u, _ = self._leases.pop(lid)
                 if self._inflight.get(u, 0) > 0:
                     self._inflight[u] -= 1
+                self._drop_lease_class(lid, u)
             return web.json_response({"ok": True})
         # Legacy: release by url. Must ALSO retire the lease pointing at
         # that url — otherwise the orphaned lease's TTL expiry later
@@ -446,6 +495,25 @@ class GserverManager:
         matches = [lid for lid, (lu, _) in self._leases.items() if lu == u]
         if len(matches) == 1:
             del self._leases[matches[0]]
+            self._drop_lease_class(matches[0], u)
+        elif matches:
+            # Ambiguous: no lease is retired (guessing could delete
+            # another client's), but the per-class gauge must move with
+            # the _inflight decrement below or the two drift apart until
+            # TTL expiry. Legacy by-url clients predate request classes,
+            # so prefer a rollout lease's class; the lease's class record
+            # stays (the lease is still alive), giving the class count
+            # the same guarded double-decrement-at-expiry semantics as
+            # _inflight itself.
+            lid2 = next(
+                (l for l in matches
+                 if self._lease_class.get(l, "rollout") == "rollout"),
+                matches[0],
+            )
+            cls = self._lease_class.get(lid2, "rollout")
+            by = self._inflight_cls.get(u)
+            if by and by.get(cls, 0) > 0:
+                by[cls] -= 1
         if u in self._inflight and self._inflight[u] > 0:
             self._inflight[u] -= 1
         return web.json_response({"ok": True})
@@ -498,6 +566,14 @@ class GserverManager:
             "gsmgr_known_servers": len(self.health),
             "gsmgr_lease_depth": len(self._leases),
             "gsmgr_inflight_requests": sum(self._inflight.values()),
+            # Per-class lease load (docs/serving.md): one fleet carrying
+            # rollout + interactive/eval traffic concurrently.
+            **{
+                f"gsmgr_inflight_{c}": sum(
+                    by.get(c, 0) for by in self._inflight_cls.values()
+                )
+                for c in REQUEST_CLASSES
+            },
             "gsmgr_staled": float(self.is_staled()),
             "gsmgr_weight_sync_fanout_secs": self.last_sync_fanout_secs,
             "gsmgr_weight_sync_e2e_secs": self.last_sync_e2e_secs,
@@ -518,6 +594,10 @@ class GserverManager:
             "accepted_rollouts": self.accepted_rollouts,
             "healthy_servers": len(self.servers),
             "known_servers": len(self.health),
+            "inflight_by_class": {
+                c: sum(by.get(c, 0) for by in self._inflight_cls.values())
+                for c in REQUEST_CLASSES
+            },
             "fleet": {
                 u: {
                     "routable": st.routable,

@@ -65,11 +65,17 @@ class PartialRolloutClient:
     def __init__(self, manager_url: str, session, chunk_tokens: int = 128,
                  retry: Optional[RetryPolicy] = None,
                  fault_injector: Optional[FaultInjector] = None,
-                 no_server_wait_secs: float = 180.0):
+                 no_server_wait_secs: float = 180.0,
+                 request_class: str = "rollout"):
         self.manager_url = manager_url
         self.session = session  # aiohttp.ClientSession
         self.chunk_tokens = chunk_tokens
         self.retry = retry or DEFAULT_GENERATION_RETRY
+        # Serving-engine request class (docs/serving.md): tags the
+        # manager's lease and the server's admission/priority/SLO
+        # decisions. "interactive"/"eval" clients share the fleet with
+        # bulk rollout traffic at a higher scheduling priority.
+        self.request_class = request_class
         # Whole-fleet-empty budget: must comfortably outlast an eviction +
         # re-admission cycle — detection (health interval x threshold, ~6s
         # at defaults) plus the re-admission weight reconcile, which is
@@ -86,7 +92,8 @@ class PartialRolloutClient:
         if self.faults is not None:
             self.faults.maybe_fail("schedule")
         async with self.session.post(
-            f"{self.manager_url}/schedule_request", json={}
+            f"{self.manager_url}/schedule_request",
+            json={"class": self.request_class},
         ) as r:
             d = await r.json()
         if not d.get("url"):
@@ -155,6 +162,7 @@ class PartialRolloutClient:
         rid = uuid.uuid4().hex  # keys the server's persistent decode state
         failures = 0  # CONSECUTIVE chunk failures; any success resets
         fleet_waited = 0.0  # time spent waiting out an empty fleet
+        throttled = 0.0  # time spent backing off admission 429s
         try:
             while len(acc_ids) < gconfig.max_new_tokens:
                 left = gconfig.max_new_tokens - len(acc_ids)
@@ -165,6 +173,7 @@ class PartialRolloutClient:
                     url = route["url"]
                     body = {
                         "rid": rid,
+                        "class": self.request_class,
                         "tokens_done": len(acc_ids),
                         "prompt_ids": list(prompt_ids) + acc_ids,
                         "gconfig": {
@@ -173,6 +182,12 @@ class PartialRolloutClient:
                             "n": 1,
                         },
                         "max_tokens": min(self.chunk_tokens, left),
+                        # Full remaining token budget, not just this
+                        # chunk: lets admission reject an infeasible
+                        # prompt+budget at chunk 1 (413) instead of
+                        # decoding to the capacity ceiling and abandoning
+                        # mid-flight with every accumulated token paid for.
+                        "budget_total": left,
                     }
                     if self.faults is not None:
                         self.faults.maybe_fail("generate", url=url,
@@ -180,6 +195,48 @@ class PartialRolloutClient:
                     t_chunk = time.monotonic()
                     async with self.session.post(f"{url}/generate",
                                                  json=body) as r:
+                        if r.status == 429:
+                            # Admission backpressure (docs/serving.md):
+                            # the server's class queue is full. Honor the
+                            # retry-after hint on a separate budget — a
+                            # throttle is not a failure and must not burn
+                            # the chunk-failover attempts.
+                            d429 = await r.json()
+                            ra = float(d429.get("retry_after", 0.2))
+                            telemetry.inc("rollout/admission_backoff")
+                            await self._release_quiet(route)
+                            route = None
+                            if throttled >= self.no_server_wait_secs:
+                                self.n_abandoned += 1
+                                telemetry.inc("rollout/abandoned")
+                                raise GenerationAbandonedError(
+                                    f"admission-rejected for "
+                                    f"{throttled:.0f}s "
+                                    f"({len(acc_ids)} tokens accumulated)"
+                                )
+                            # Clamp to the remaining throttle budget: the
+                            # server hint is operator-set and unbounded,
+                            # and one oversized Retry-After must not
+                            # sleep past the no_server_wait_secs ceiling
+                            # the abandonment check enforces.
+                            wait = min(
+                                max(ra, 0.05),
+                                max(self.no_server_wait_secs - throttled,
+                                    0.05),
+                            )
+                            throttled += wait
+                            await asyncio.sleep(wait)
+                            continue
+                        if r.status == 413:
+                            # Permanent for this request: the prefix can
+                            # never fit a KV capacity bucket.
+                            self.n_abandoned += 1
+                            telemetry.inc("rollout/abandoned")
+                            raise GenerationAbandonedError(
+                                f"prompt too long for the serving "
+                                f"engine's KV capacity "
+                                f"({len(prompt_ids) + len(acc_ids)} tokens)"
+                            )
                         if r.status != 200:
                             raise RuntimeError(
                                 f"/generate status {r.status}"
@@ -189,6 +246,8 @@ class PartialRolloutClient:
                                       time.monotonic() - t_chunk)
                 except asyncio.CancelledError:
                     raise
+                except GenerationAbandonedError:
+                    raise  # terminal (429 budget / 413): not a failover
                 except NoHealthyServersError as e:
                     # Empty fleet 503s come back in milliseconds — counting
                     # them against the chunk-failover budget would abandon
@@ -231,6 +290,7 @@ class PartialRolloutClient:
                     continue
                 failures = 0
                 fleet_waited = 0.0
+                throttled = 0.0
                 n_chunks += 1
                 acc_ids += list(out["output_ids"])
                 acc_lps += list(out["output_logprobs"])

@@ -118,6 +118,243 @@ def test_decode_chunk_rows_at_different_lengths(model):
         np.testing.assert_array_equal(got[r][: m.sum()], ref_toks[r][:8][m])
 
 
+def test_extend_state_matches_full_prefill(model):
+    """Prefix seeding's primitive: prefill(prefix) + extend(suffix) decodes
+    the same greedy tokens as prefill(prefix+suffix) — including when the
+    suffix is right-padded to a bucket (garbage slots masked/overwritten)."""
+    cfg, params = model
+    rng = np.random.RandomState(11)
+    common = rng.randint(2, 90, 10).tolist()
+    full = common + rng.randint(2, 90, 5).tolist()
+    g = GenerationHyperparameters(greedy=True, max_new_tokens=12)
+    key = jax.random.PRNGKey(1)
+
+    padded, plens = genmod.pad_prompts([full], 0, bucket=16)
+    ref = genmod.prefill_state(
+        params, cfg, jnp.asarray(padded), jnp.asarray(plens), S=64
+    )
+    ref, ref_out = genmod.decode_chunk(
+        params, cfg, ref, jnp.zeros(1, jnp.int32), key, g, n_tokens=12,
+        eos_token_id=1, pad_token_id=0,
+    )
+
+    pc, lc = genmod.pad_prompts([common], 0, bucket=16)
+    donor = genmod.prefill_state(
+        params, cfg, jnp.asarray(pc), jnp.asarray(lc), S=64
+    )
+    st = genmod.clone_prefix(donor, len(common))
+    suffix = np.asarray(full[len(common):], np.int32)
+    T = 8  # padded: 5 real + 3 pad tokens
+    padsuf = np.zeros((1, T), np.int32)
+    padsuf[0, :len(suffix)] = suffix
+    st = genmod.extend_state(
+        params, cfg, st, jnp.asarray(padsuf),
+        jnp.asarray([len(suffix)], jnp.int32),
+    )
+    assert int(st["cur_len"][0]) == len(full)
+    st, out = genmod.decode_chunk(
+        params, cfg, st, jnp.zeros(1, jnp.int32), key, g, n_tokens=12,
+        eos_token_id=1, pad_token_id=0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["output_ids"]), np.asarray(ref_out["output_ids"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["output_logprobs"]),
+        np.asarray(ref_out["output_logprobs"]), rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_row_budget_freezes_state_at_allowance(model):
+    """Regression: a row truncated by ``row_budget`` must retain exactly
+    the state it had at its allowance — same cur_len AND last_logits as a
+    run that stopped there. The pad-token steps after a row finishes must
+    not clobber the carried logits: a serving-mode retained state hands
+    them to chunk continuations and full-match prefix clones."""
+    cfg, params = model
+    from areal_tpu.ops.sampling import sampling_from_gconfigs
+
+    padded, plens = _prompts()
+    g = GenerationHyperparameters(greedy=True, max_new_tokens=8)
+    key = jax.random.PRNGKey(2)
+    sampling = sampling_from_gconfigs([g] * 4)
+
+    def _run(n_tokens, row_budget):
+        # fresh prefill per run: decode_chunk_rows donates its state
+        st = genmod.prefill_state(
+            params, cfg, jnp.asarray(padded), jnp.asarray(plens), S=64
+        )
+        return genmod.decode_chunk_rows(
+            params, cfg, st, jnp.zeros(4, jnp.int32), key, sampling,
+            n_tokens=n_tokens, eos_token_id=1, pad_token_id=0,
+            row_budget=row_budget,
+        )
+
+    long_st, long_out = _run(8, jnp.full(4, 3, jnp.int32))
+    short_st, short_out = _run(3, None)
+    np.testing.assert_array_equal(
+        np.asarray(long_st["cur_len"]), np.asarray(short_st["cur_len"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(long_st["last_logits"]),
+        np.asarray(short_st["last_logits"]), rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(long_out["output_ids"])[:, :3],
+        np.asarray(short_out["output_ids"]),
+    )
+
+
+def _serving_server(model, prefix_reuse: bool):
+    from areal_tpu.api.train_config import ServingConfig
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+
+    cfg, params = model
+    return GenerationServer(
+        GenerationServerConfig(
+            experiment="kvreuse", trial="t0", chunk_tokens=6,
+            prompt_bucket=8, kv_bucket=32,
+            # EOS off the greedy path for these prompts/weights: the donor
+            # must run its full allowance so its state is retained.
+            eos_token_id=96,
+            serving=ServingConfig(
+                enabled=True, prefix_reuse=prefix_reuse,
+                min_prefix_tokens=4, max_kv_capacity=256,
+            ),
+        ),
+        cfg, params,
+    )
+
+
+def _decode_one(server, prompt, rid, max_tokens=6):
+    from areal_tpu.system.generation_server import _Pending
+
+    g = GenerationHyperparameters(greedy=True, max_new_tokens=max_tokens)
+    (res,) = server._decode_batch([_Pending(
+        prompt=np.asarray(prompt, np.int32), gconfig=g,
+        max_tokens=max_tokens, future=None, rid=rid,
+    )])
+    return res
+
+
+@pytest.mark.serving
+def test_cross_request_prefix_seeding_parity(model):
+    """Acceptance (docs/serving.md): greedy outputs are bit-identical with
+    serving.prefix_reuse on vs off; the prefill-token counter shows reuse
+    actually skipped prefill work; and parity survives donor eviction."""
+    rng = np.random.RandomState(5)
+    prompt_a = rng.randint(2, 90, 12).tolist()
+    # B shares A's first 8 tokens, then diverges.
+    prompt_b = prompt_a[:8] + rng.randint(2, 90, 4).tolist()
+
+    on = _serving_server(model, prefix_reuse=True)
+    off = _serving_server(model, prefix_reuse=False)
+
+    # Donor request on both servers: full allowance without EOS retains
+    # the decode state (and, on the reuse server, indexes it in the trie).
+    res_a_on = _decode_one(on, prompt_a, rid="ra")
+    res_a_off = _decode_one(off, prompt_a, rid="ra")
+    assert res_a_on == res_a_off
+    assert on.serving.kv.count == 1
+
+    prefill_on_before = on._prefill_tokens
+    prefill_off_before = off._prefill_tokens
+    res_b_on = _decode_one(on, prompt_b, rid="rb")
+    res_b_off = _decode_one(off, prompt_b, rid="rb")
+    # Bit-identical outputs with reuse on vs off.
+    assert res_b_on["output_ids"] == res_b_off["output_ids"]
+    np.testing.assert_allclose(
+        res_b_on["output_logprobs"], res_b_off["output_logprobs"],
+        rtol=2e-4, atol=2e-4,
+    )
+    # Reuse genuinely skipped prefill: only the 4-token suffix was
+    # prefilled on the reuse server vs the full 12-token prompt without.
+    assert on._prefill_tokens - prefill_on_before == len(prompt_b) - 8
+    assert off._prefill_tokens - prefill_off_before == len(prompt_b)
+
+    # Donor evicted: same request (fresh rid) falls back to a full
+    # prefill and still produces identical output.
+    on.serving.kv.clear()
+    prefill_before = on._prefill_tokens
+    res_c_on = _decode_one(on, prompt_b, rid="rc")
+    assert res_c_on["output_ids"] == res_b_on["output_ids"]
+    assert on._prefill_tokens - prefill_before == len(prompt_b)
+
+
+@pytest.mark.serving
+def test_prefix_seeding_savings_gate(model):
+    """Seeding is skipped when the bucketed suffix width equals the
+    full-prompt prefill width — same padded matmul, so reuse would only
+    add clone overhead and a serial B=1 extend. The request rides the
+    plain batched prefill and parity still holds."""
+    rng = np.random.RandomState(11)
+    prompt_a = rng.randint(2, 90, 8).tolist()
+    # Shares exactly min_prefix_tokens=4, then diverges by construction;
+    # both prompts (and the 4-token suffix) round to the same 8-wide
+    # width bucket, so there are no padded-compute savings.
+    prompt_b = prompt_a[:4] + [(t + 1) % 90 + 2 for t in prompt_a[4:]]
+
+    on = _serving_server(model, prefix_reuse=True)
+    off = _serving_server(model, prefix_reuse=False)
+    _decode_one(on, prompt_a, rid="ra")
+    _decode_one(off, prompt_a, rid="ra")
+    assert on.serving.kv.count == 1
+
+    before = on._prefill_tokens
+    res_on = _decode_one(on, prompt_b, rid="rb")
+    res_off = _decode_one(off, prompt_b, rid="rb")
+    # The savings gate fell back to a full prefill despite the donor.
+    assert on._prefill_tokens - before == len(prompt_b)
+    assert res_on["output_ids"] == res_off["output_ids"]
+
+
+@pytest.mark.serving
+def test_budget_truncated_donor_parity(model):
+    """Regression: a donor retained after exhausting its per-request
+    budget BEFORE the static chunk length (serving keeps n == allowance
+    rows) must seed an exact-full-match clone bit-identically — its
+    last_logits are the ones after its last real token, not after the
+    chunk's trailing pad steps."""
+    rng = np.random.RandomState(9)
+    prompt_a = rng.randint(2, 90, 10).tolist()
+
+    on = _serving_server(model, prefix_reuse=True)
+    off = _serving_server(model, prefix_reuse=False)
+
+    # Donor truncated by its own budget (3 < chunk_tokens=6): serving
+    # mode retains it as a prefix-reuse donor.
+    res_a = _decode_one(on, prompt_a, rid="ra", max_tokens=3)
+    _decode_one(off, prompt_a, rid="ra", max_tokens=3)
+    assert len(res_a["output_ids"]) == 3
+    assert on.serving.kv.count == 1
+
+    # New request = the donor's full retained sequence: exact match, pure
+    # clone — the first sampled token comes straight from the donor's
+    # retained last_logits.
+    prompt_b = prompt_a + res_a["output_ids"]
+    prefill_before = on._prefill_tokens
+    res_b_on = _decode_one(on, prompt_b, rid="rb", max_tokens=4)
+    res_b_off = _decode_one(off, prompt_b, rid="rb", max_tokens=4)
+    assert on._prefill_tokens == prefill_before  # zero prefill work
+    assert res_b_on["output_ids"] == res_b_off["output_ids"]
+    np.testing.assert_allclose(
+        res_b_on["output_logprobs"], res_b_off["output_logprobs"],
+        rtol=2e-4, atol=2e-4,
+    )
+
+    # Regression: the pure-clone row decoded as a single-row group, and a
+    # one-state stack_states is the identity on its arrays — the donated
+    # decode must not have deleted the donor's retained buffers in place.
+    # Drop rb's retained state so the next clone MUST come from the same
+    # donor, then decode through it again.
+    on.serving.kv.pop("rb")
+    res_c_on = _decode_one(on, prompt_b, rid="rc", max_tokens=4)
+    assert res_c_on["output_ids"] == res_b_on["output_ids"]
+
+
 def test_grow_state_preserves_decode(model):
     cfg, params = model
     padded, plens = _prompts()

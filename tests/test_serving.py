@@ -1,0 +1,800 @@
+"""Serving engine (system/serving.py, docs/serving.md): admission control,
+class priority, refcounted KV pinning, bounded compile shapes, class-aware
+lease routing, and the 429 backpressure path of the chunked client.
+
+Everything is bounded to seconds: in-process fakes or tiny real models,
+zero real sleeps beyond millisecond batch windows.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.train_config import ServingConfig
+from areal_tpu.base import name_resolve, names, network
+from areal_tpu.system.serving import (
+    REQUEST_CLASSES,
+    AdmissionReject,
+    KVStateStore,
+    PrefixTrie,
+    PromptTooLong,
+    ReqState,
+    ServingEngine,
+    ServingQueue,
+    ShapeBucketPolicy,
+    normalize_class,
+    policy_from_config,
+)
+
+EXP, TRIAL = "servtest", "t0"
+
+
+def _scfg(**kw) -> ServingConfig:
+    # Test servers run kv_bucket=32: keep the derived capacity ladder
+    # consistent with the default shape cap.
+    kw.setdefault("max_kv_capacity", 256)
+    return ServingConfig(enabled=True, **kw)
+
+
+# ------------------------------------------------------------- shape policy
+
+
+@pytest.mark.serving
+def test_shape_policy_bounded_rounding():
+    pol = ShapeBucketPolicy(
+        quantum=32, capacity_buckets=[64, 128, 256],
+        chunk_buckets=[4, 8], row_buckets=[1, 2, 4], max_shapes=64,
+    )
+    assert pol.round_capacity(1) == 64
+    assert pol.round_capacity(65) == 128
+    assert pol.round_capacity(256) == 256
+    with pytest.raises(PromptTooLong):
+        pol.round_capacity(257)
+    assert not pol.fits(257) and pol.fits(256)
+    assert pol.round_chunk(3) == 4
+    assert pol.round_chunk(5) == 8
+    assert pol.round_chunk(100) == 8  # clamped to the largest bucket
+    assert pol.round_rows(3) == 4
+    assert pol.round_rows(9) == 4  # clamped
+    pol.observe("decode", 2, 64, 8)
+    pol.observe("decode", 2, 64, 8)  # dedup
+    pol.observe("prefill", 2, 16, 64)
+    assert pol.distinct_shapes == 2
+
+
+@pytest.mark.serving
+def test_shape_policy_legacy_passthrough():
+    pol = ShapeBucketPolicy(quantum=256)
+    assert pol.round_capacity(1) == 256
+    assert pol.round_capacity(257) == 512  # unbounded multiples
+    assert pol.round_chunk(77) == 77
+    assert pol.round_rows(13) == 13
+    assert pol.fits(10**9)
+
+
+@pytest.mark.serving
+def test_shape_policy_refuses_overwide_buckets():
+    with pytest.raises(ValueError, match="max_compiled_shapes"):
+        ShapeBucketPolicy(
+            quantum=32, capacity_buckets=list(range(64, 64 * 20, 64)),
+            chunk_buckets=[2, 4, 8, 16], row_buckets=[1, 2, 4, 8],
+            max_shapes=16,
+        )
+
+
+@pytest.mark.serving
+def test_policy_from_config_derives_buckets():
+    cfg = _scfg(max_kv_capacity=1024, max_compiled_shapes=64)
+    pol = policy_from_config(
+        cfg, kv_bucket=128, chunk_tokens=16, max_batch_size=8,
+        prompt_bucket=128,
+    )
+    assert pol.capacity_buckets == [128, 256, 512, 1024]
+    assert pol.chunk_buckets == [16]
+    assert pol.row_buckets == [1, 2, 4, 8]
+    # Width ladder: geometric from prompt_bucket, final bucket at the
+    # widest prefill that still fits one minimum chunk under the ceiling.
+    assert pol.width_buckets == [128, 256, 512, 1008]
+    assert pol.round_width(100) == 128
+    assert pol.round_width(600) == 1008
+    with pytest.raises(PromptTooLong):
+        pol.round_width(1009)
+    # disabled config -> legacy
+    legacy = policy_from_config(
+        ServingConfig(), kv_bucket=128, chunk_tokens=16, max_batch_size=8,
+        prompt_bucket=128,
+    )
+    assert legacy.capacity_buckets is None
+    assert legacy.round_width(37) == 37  # pass-through
+
+
+@pytest.mark.serving
+def test_policy_refuses_row_buckets_below_batch_size():
+    """row_buckets whose max is under max_batch_size would clamp bigger
+    drains DOWN — the decode batch then runs at its raw size, compiling
+    per exact batch size. Config error, refused at construction."""
+    cfg = _scfg(row_buckets=[1, 2, 4])
+    with pytest.raises(ValueError, match="row_buckets"):
+        policy_from_config(
+            cfg, kv_bucket=32, chunk_tokens=4, max_batch_size=8,
+            prompt_bucket=8,
+        )
+    # max bucket == batch size is fine
+    policy_from_config(
+        cfg, kv_bucket=32, chunk_tokens=4, max_batch_size=4,
+        prompt_bucket=8,
+    )
+
+
+@pytest.mark.serving
+def test_policy_refuses_degenerate_width_ladder():
+    """A chunk bucket at (or near) max_kv_capacity leaves no width room:
+    the ladder would collapse to [1] and 413 EVERY request at admission.
+    Refused at construction — which validate_config runs at parse time —
+    instead of surfacing as a fleet-wide runtime reject."""
+    cfg = _scfg(chunk_buckets=[256], max_kv_capacity=256)
+    with pytest.raises(ValueError, match="max_kv_capacity"):
+        policy_from_config(
+            cfg, kv_bucket=32, chunk_tokens=4, max_batch_size=4,
+            prompt_bucket=8,
+        )
+    # One prompt_bucket of room is the floor of validity.
+    policy_from_config(
+        _scfg(chunk_buckets=[248], max_kv_capacity=256), kv_bucket=32,
+        chunk_tokens=4, max_batch_size=4, prompt_bucket=8,
+    )
+
+
+@pytest.mark.serving
+def test_policy_total_shape_bound_includes_widths():
+    """The cap check covers prefill/extend widths, not just the decode
+    product: a config whose decode product fits but whose total worst
+    case (decode + prefill + extend) does not must refuse."""
+    kw = dict(
+        quantum=32, capacity_buckets=[64, 128, 256],
+        chunk_buckets=[8], row_buckets=[1, 2, 4],
+    )
+    # decode product = 9; widths add 3*4*1 + 4*3 = 24 -> 33 total.
+    ShapeBucketPolicy(width_buckets=[8, 16, 32, 248], max_shapes=33, **kw)
+    with pytest.raises(ValueError, match="max_compiled_shapes"):
+        ShapeBucketPolicy(
+            width_buckets=[8, 16, 32, 248], max_shapes=32, **kw
+        )
+
+
+# ------------------------------------------------------------- prefix trie
+
+
+@pytest.mark.serving
+def test_prefix_trie_longest_and_prune():
+    trie = PrefixTrie()
+    trie.insert("a", np.asarray([1, 2, 3, 4]))
+    trie.insert("b", np.asarray([1, 2, 9]))
+    rid, depth = trie.longest([1, 2, 3, 7, 7])
+    assert (rid, depth) == ("a", 3)
+    rid, depth = trie.longest([1, 2, 9, 9])
+    assert (rid, depth) == ("b", 3)
+    assert trie.longest([5, 5]) == (None, 0)
+    trie.remove("a", np.asarray([1, 2, 3, 4]))
+    rid, depth = trie.longest([1, 2, 3, 7])
+    assert (rid, depth) == ("b", 2)  # a's branch pruned, b still covers 1,2
+    trie.remove("b", np.asarray([1, 2, 9]))
+    assert trie.longest([1, 2]) == (None, 0)
+    assert not trie._root.children  # fully pruned
+
+
+def _state(nbytes_each: int = 8):
+    class _Arr:
+        def __init__(self, n):
+            self.nbytes = n
+
+    return {"kv_k": _Arr(nbytes_each), "kv_v": _Arr(nbytes_each)}
+
+
+# ------------------------------------------------- KV store: pins + budgets
+
+
+@pytest.mark.serving
+def test_kv_store_refcounted_pin_survives_eviction():
+    kv = KVStateStore(slots=2, bytes_budget=1 << 30, prefix_reuse=True)
+    for i in range(3):
+        kv.put(f"r{i}", ReqState(_state(), cur_len=4, version=0,
+                                 tokens=np.asarray([9, 9, 9, i])))
+        time.sleep(0.002)  # distinct last_used ordering
+    # r0 is LRU; pin it via acquire_prefix and overfill the store.
+    got = kv.acquire_prefix([9, 9, 9, 0, 5], version=0, min_len=2)
+    assert got is not None
+    rid, shared = got
+    assert rid == "r0" and shared == 4
+    kv.evict()
+    # r0 was pinned: eviction must drop the other LRU entries instead.
+    assert kv.get("r0") is not None and kv.count <= 2
+    kv.release(rid)
+    assert kv.get("r0").pins == 0
+    kv.get("r0").last_used = 0.0  # age it: acquire bumped recency
+    kv.put("r9", ReqState(_state(), cur_len=4, version=0,
+                          tokens=np.asarray([1, 1, 1, 1])))
+    kv.evict()
+    assert kv.count <= 2
+    assert kv.get("r0") is None  # released: normal LRU victim
+
+
+@pytest.mark.serving
+def test_kv_store_bytes_budget_and_version_gate():
+    kv = KVStateStore(slots=100, bytes_budget=40, prefix_reuse=True)
+    for i in range(4):  # 16 bytes each
+        kv.put(f"r{i}", ReqState(_state(8), cur_len=2, version=0,
+                                 tokens=np.asarray([3, i])))
+    kv.evict()
+    assert kv.nbytes <= 40 and kv.count == 2
+    # version mismatch: no donor even though the trie matches
+    assert kv.acquire_prefix([3, 3], version=1, min_len=1) is None
+    kv.clear()
+    assert kv.count == 0 and kv.acquire_prefix([3, 3], 0, 1) is None
+
+
+@pytest.mark.serving
+def test_acquire_prefix_full_match_clamp():
+    kv = KVStateStore(slots=8, bytes_budget=1 << 30, prefix_reuse=True)
+    kv.put("d", ReqState(_state(), cur_len=6, version=0,
+                         tokens=np.asarray([1, 2, 3, 4, 5, 6])))
+    # Query equal to a PREFIX of the donor: must leave >= 1 suffix token
+    # to recompute last_logits -> shared clamps to len(query) - 1.
+    rid, shared = kv.acquire_prefix([1, 2, 3, 4], version=0, min_len=1)
+    assert rid == "d" and shared == 3
+    kv.release("d")
+    # Query equal to the donor's FULL sequence: exact match, logits usable.
+    rid, shared = kv.acquire_prefix([1, 2, 3, 4, 5, 6], version=0, min_len=1)
+    assert rid == "d" and shared == 6
+    kv.release("d")
+    # min_len gate
+    assert kv.acquire_prefix([1, 9], version=0, min_len=4) is None
+
+
+# ----------------------------------------------- queue: admission, priority
+
+
+@pytest.mark.serving
+def test_queue_admission_reject_and_priority_order():
+    q = ServingQueue(_scfg(
+        queue_limit_rollout=2, queue_limit_interactive=1,
+        retry_after_secs=0.7,
+    ))
+    q.put("r1", "rollout")
+    q.put("r2", "rollout")
+    with pytest.raises(AdmissionReject) as ei:
+        q.put("r3", "rollout")
+    assert ei.value.retry_after == pytest.approx(0.7)
+    assert ei.value.cls == "rollout" and ei.value.limit == 2
+    q.put("e1", "eval")
+    q.put("i1", "interactive")
+    with pytest.raises(AdmissionReject):
+        q.put("i2", "interactive")
+    # Priority drain: interactive > eval > rollout, FIFO within a class.
+    assert q.drain(10) == ["i1", "e1", "r1", "r2"]
+    assert q.empty()
+
+
+@pytest.mark.serving
+def test_queue_rollout_reserved_share_under_contention():
+    """Sustained interactive load cannot starve rollout: every drained
+    batch reserves min_rollout_share of its slots for waiting rollout
+    requests (else training data production stalls while serving SLOs
+    look healthy); share=0 restores strict priority."""
+    q = ServingQueue(_scfg(min_rollout_share=0.25))
+    for i in range(8):
+        q.put(f"i{i}", "interactive")
+    for i in range(4):
+        q.put(f"r{i}", "rollout")
+    # 3 interactive by priority + 1 reserved rollout slot, per batch.
+    assert q.drain(4) == ["i0", "i1", "i2", "r0"]
+    assert q.drain(4) == ["i3", "i4", "i5", "r1"]
+    # Reservation never over-pops: once rollout runs dry mid-batch the
+    # remaining slots flow back to priority order.
+    assert q.drain(8) == ["i6", "i7", "r2", "r3"]
+
+    q0 = ServingQueue(_scfg(min_rollout_share=0.0))
+    q0.put("r", "rollout")
+    q0.put("i", "interactive")
+    assert q0.drain(1) == ["i"]
+
+
+@pytest.mark.serving
+def test_queue_disabled_is_unbounded_fifo():
+    q = ServingQueue(ServingConfig(enabled=False, queue_limit_rollout=1))
+    for i in range(5):
+        q.put(i, "interactive" if i % 2 else "rollout")
+    assert q.drain(10) == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.serving
+def test_queue_async_get_wakes_on_put():
+    async def main():
+        q = ServingQueue(_scfg())
+        getter = asyncio.create_task(q.get())
+        await asyncio.sleep(0.01)
+        q.put("x", "rollout")
+        assert await asyncio.wait_for(getter, 2) == "x"
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+def test_admit_planned_len_rejects_infeasible_up_front():
+    """A chunked client's full remaining budget is feasibility-checked at
+    chunk 1 (vLLM's prompt+max_tokens admission): a generation whose
+    eventual total sequence cannot fit the widest width bucket 413s now,
+    instead of decoding up to the capacity ceiling and abandoning
+    mid-flight with every accumulated token discarded."""
+    eng = ServingEngine(
+        _scfg(), kv_slots=4, kv_bytes_budget=1 << 20, kv_bucket=32,
+        chunk_tokens=4, max_batch_size=4, prompt_bucket=8,
+    )
+    widest = eng.shapes.width_buckets[-1]
+    # The prompt alone fits; the planned total cannot.
+    with pytest.raises(PromptTooLong):
+        eng.admit(object(), "rollout", prompt_len=8,
+                  planned_len=widest + 2)
+    assert eng.queue.empty()
+    # Same prompt with a feasible budget admits (widest prompt_bucket
+    # multiple under the width ceiling, worst-case no-EOS final chunk).
+    feasible = widest // eng.prompt_bucket * eng.prompt_bucket
+    eng.admit(object(), "rollout", prompt_len=8, planned_len=feasible)
+    assert eng.queue.depth("rollout") == 1
+    # No planned_len (single-shot / third-party client): only the prompt
+    # is checked — the pre-existing behavior.
+    eng.admit(object(), "interactive", prompt_len=8)
+    assert eng.queue.depth("interactive") == 1
+
+
+@pytest.mark.serving
+def test_normalize_class():
+    assert normalize_class("interactive") == "interactive"
+    assert normalize_class("bogus") == "rollout"
+    assert normalize_class(None) == "rollout"
+
+
+# ------------------------------------------------- real-server integration
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(vocab_size=97)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _server(tiny_model, serving_cfg=None, **kw):
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+
+    mcfg, params = tiny_model
+    cfg = GenerationServerConfig(
+        experiment=EXP, trial=TRIAL, chunk_tokens=4, prompt_bucket=8,
+        kv_bucket=32, batch_window_ms=1,
+        serving=serving_cfg or ServingConfig(), **kw,
+    )
+    return GenerationServer(cfg, mcfg, params)
+
+
+def _gen_body(prompt, rid, cls="rollout", max_tokens=4, greedy=True):
+    return {
+        "prompt_ids": [int(t) for t in prompt],
+        "rid": rid,
+        "class": cls,
+        "gconfig": {"greedy": greedy, "max_new_tokens": max_tokens},
+        "max_tokens": max_tokens,
+    }
+
+
+class _Req:
+    def __init__(self, d):
+        self._d = d
+
+    async def json(self):
+        return self._d
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(120)
+def test_admission_reject_http_429(tiny_model):
+    """Handler-level: with the runner stopped, the class queue fills to
+    its limit and the next request gets 429 + Retry-After, while other
+    classes still admit."""
+    srv = _server(tiny_model, _scfg(
+        queue_limit_rollout=2, retry_after_secs=0.3,
+    ))
+
+    async def main():
+        hung = [
+            asyncio.create_task(srv.handle_generate(
+                _Req(_gen_body([5, 6, 7], f"q{i}"))
+            ))
+            for i in range(2)
+        ]
+        await asyncio.sleep(0.05)  # both enqueued, nothing drains
+        resp = await srv.handle_generate(_Req(_gen_body([5, 6, 7], "q2")))
+        assert resp.status == 429
+        # RFC 9110 delay-seconds: integer header, precise float in body.
+        assert resp.headers["Retry-After"] == "1"
+        assert b'"retry_after": 0.3' in resp.body
+        assert b"admission" in resp.body
+        # higher-priority class has its own (non-full) queue
+        resp2_task = asyncio.create_task(srv.handle_generate(
+            _Req(_gen_body([5, 6, 7], "q3", cls="interactive"))
+        ))
+        await asyncio.sleep(0.05)
+        assert srv._queue.depth("interactive") == 1
+        for t in hung + [resp2_task]:
+            t.cancel()
+        await asyncio.gather(*hung, resp2_task, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(120)
+def test_prompt_too_long_413(tiny_model):
+    srv = _server(tiny_model, _scfg(max_kv_capacity=64))
+
+    async def main():
+        resp = await srv.handle_generate(
+            _Req(_gen_body(list(range(2, 70)), "long"))
+        )
+        assert resp.status == 413
+        assert b"prompt_too_long" in resp.body
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(300)
+def test_class_priority_under_contention(tiny_model):
+    """A rollout backlog deeper than one batch is queued before an
+    interactive request arrives; the interactive request still rides the
+    FIRST formed batch (priority drain) and completes before the backlog
+    clears."""
+    srv = _server(tiny_model, _scfg(), max_batch_size=2)
+
+    async def main():
+        order = []
+
+        async def one(body, tag):
+            resp = await srv.handle_generate(_Req(body))
+            assert resp.status == 200
+            order.append(tag)
+
+        tasks = [
+            asyncio.create_task(one(_gen_body([2, 3, 4], f"r{i}"), f"r{i}"))
+            for i in range(5)
+        ]
+        await asyncio.sleep(0.05)  # all rollouts enqueued (runner not up)
+        tasks.append(asyncio.create_task(one(
+            _gen_body([2, 3, 4], "i0", cls="interactive"), "i0"
+        )))
+        await asyncio.sleep(0.05)
+        srv._runner_task = asyncio.create_task(srv._runner())
+        await asyncio.gather(*tasks)
+        srv._runner_task.cancel()
+        await asyncio.gather(srv._runner_task, return_exceptions=True)
+        # interactive arrived LAST but finished in the first decode batch
+        assert order.index("i0") < 2, order
+        assert order.index("i0") < order.index("r4")
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(300)
+def test_randomized_shape_bound_and_prometheus_scrape(tmp_path, tiny_model):
+    """Acceptance: a randomized mixed-class workload keeps the distinct
+    compiled-shape count <= the configured cap, and the gauge (plus the
+    kv_states/kv_bytes gauges and per-class SLO histograms) is visible in
+    a REAL Prometheus scrape of a running generation server."""
+    from areal_tpu.api.train_config import TelemetryConfig
+
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(
+        str(tmp_path / "nr")
+    )
+    scfg = _scfg(max_kv_capacity=128, max_compiled_shapes=48)
+    srv = _server(
+        tiny_model, scfg, max_batch_size=4,
+        telemetry=TelemetryConfig(enabled=True, flush_interval_secs=30),
+    )
+
+    async def main():
+        import aiohttp
+
+        url = await srv.start()
+        rng = np.random.RandomState(7)
+        async with aiohttp.ClientSession() as sess:
+            async def one(i):
+                cls = REQUEST_CLASSES[i % 3]
+                plen = int(rng.randint(3, 40))
+                budget = int(rng.randint(1, 7))
+                body = _gen_body(
+                    rng.randint(2, 90, plen).tolist(), f"w{i}", cls=cls,
+                    max_tokens=budget, greedy=False,
+                )
+                async with sess.post(f"{url}/generate", json=body) as r:
+                    assert r.status == 200
+                    await r.json()
+
+            for start in range(0, 24, 8):  # waves -> varied batch mixes
+                await asyncio.gather(
+                    *[one(i) for i in range(start, start + 8)]
+                )
+            assert srv.serving.shapes.distinct_shapes <= \
+                scfg.max_compiled_shapes
+            # The scrape must go over the real socket (acceptance: gauge
+            # visible in a REAL Prometheus scrape) — aiohttp, because a
+            # blocking urllib call on the loop would deadlock the server.
+            async with sess.get(f"{url}/metrics") as r:
+                assert r.status == 200
+                prom = await r.text()
+        await srv.stop()
+        return prom
+
+    prom = asyncio.run(main())
+    assert "# TYPE areal_serving_compiled_shapes gauge" in prom
+    assert "areal_genserver_kv_states" in prom
+    assert "areal_genserver_kv_bytes" in prom
+    # per-class SLO histograms through the telemetry registry
+    for cls in REQUEST_CLASSES:
+        assert f"areal_serving_{cls}_queue_wait_secs_bucket" in prom
+        assert f"areal_serving_{cls}_ttfc_secs_bucket" in prom
+    for ln in prom.splitlines():  # every sample line parses
+        if ln and not ln.startswith("#"):
+            float(ln.rpartition(" ")[2])
+
+
+# ------------------------------------------------ manager: class routing
+
+
+@pytest.mark.serving
+def test_manager_class_aware_routing():
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+    )
+
+    mgr = GserverManager(GserverManagerConfig(experiment=EXP, trial=TRIAL))
+    mgr.servers = ["http://a", "http://b"]
+    mgr._inflight = {u: 0 for u in mgr.servers}
+
+    import json
+
+    async def call(handler, body):
+        return json.loads((await handler(_Req(body))).text)
+
+    async def main():
+        # Load server a with rollout traffic via round-robin.
+        r1 = await call(mgr.handle_schedule_request, {"class": "rollout"})
+        assert r1["class"] == "rollout"
+        # Interactive routes by least interactive+eval load: the two
+        # requests must land on DIFFERENT servers.
+        i1 = await call(mgr.handle_schedule_request,
+                        {"class": "interactive"})
+        i2 = await call(mgr.handle_schedule_request,
+                        {"class": "interactive"})
+        assert {i1["url"], i2["url"]} == {"http://a", "http://b"}
+        # Per-class bookkeeping visible in /metrics.json
+        mj = await call(mgr.handle_metrics_json, {})
+        assert mj["inflight_by_class"]["interactive"] == 2
+        assert mj["inflight_by_class"]["rollout"] == 1
+        # Release by lease drops the right class count.
+        await call(mgr.handle_release, {"lease_id": i1["lease_id"]})
+        mj = await call(mgr.handle_metrics_json, {})
+        assert mj["inflight_by_class"]["interactive"] == 1
+        # Legacy empty-body schedule still works (defaults to rollout).
+        r2 = await call(mgr.handle_schedule_request, {})
+        assert r2["class"] == "rollout"
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+def test_manager_ambiguous_by_url_release_keeps_class_gauge_in_step():
+    """Legacy by-url release with MULTIPLE leases on the url retires no
+    lease (guessing could delete another client's) but still decrements
+    _inflight — the per-class gauge must move with it, not drift above
+    the real load until TTL expiry."""
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+    )
+
+    mgr = GserverManager(GserverManagerConfig(experiment=EXP, trial=TRIAL))
+    mgr.servers = ["http://a"]
+    mgr._inflight = {u: 0 for u in mgr.servers}
+
+    import json
+
+    async def call(handler, body):
+        return json.loads((await handler(_Req(body))).text)
+
+    async def main():
+        r1 = await call(mgr.handle_schedule_request, {"class": "rollout"})
+        r2 = await call(mgr.handle_schedule_request, {"class": "rollout"})
+        assert r1["url"] == r2["url"] == "http://a"
+        assert mgr._inflight["http://a"] == 2
+        await call(mgr.handle_release, {"url": "http://a"})
+        await call(mgr.handle_release, {"url": "http://a"})
+        assert mgr._inflight["http://a"] == 0
+        mj = await call(mgr.handle_metrics_json, {})
+        assert mj["inflight_by_class"]["rollout"] == 0
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------ client: 429 backpressure
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(120)
+def test_client_backs_off_on_429_without_burning_failover():
+    """A 429 from admission control honors Retry-After on its own budget:
+    the chunk completes after the throttle clears and n_failovers stays 0."""
+    from aiohttp import web
+
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.base.retry import RetryPolicy
+    from areal_tpu.system.partial_rollout import PartialRolloutClient
+
+    state = {"n": 0}
+
+    async def fake_generate(request):
+        state["n"] += 1
+        if state["n"] <= 2:
+            return web.json_response(
+                {"ok": False, "reason": "admission", "retry_after": 0.01},
+                status=429,
+            )
+        return web.json_response({
+            "output_ids": [7, 1], "output_logprobs": [-0.1, -0.2],
+            "finished": True, "version": 0,
+        })
+
+    async def main():
+        import aiohttp
+
+        app = web.Application()
+        app.router.add_post("/generate", fake_generate)
+        gen_runner = web.AppRunner(app)
+        await gen_runner.setup()
+        gport = network.find_free_port()
+        await web.TCPSite(gen_runner, "127.0.0.1", gport).start()
+        gurl = f"http://127.0.0.1:{gport}"
+
+        mgr_app = web.Application()
+
+        async def sched(request):
+            d = await request.json()
+            assert d.get("class") == "interactive"
+            return web.json_response({"url": gurl, "version": 0})
+
+        async def ok(request):
+            return web.json_response({"ok": True})
+
+        mgr_app.router.add_post("/schedule_request", sched)
+        mgr_app.router.add_post("/release", ok)
+        mgr_app.router.add_post("/renew", ok)
+        mgr_runner = web.AppRunner(mgr_app)
+        await mgr_runner.setup()
+        mport = network.find_free_port()
+        await web.TCPSite(mgr_runner, "127.0.0.1", mport).start()
+
+        async with aiohttp.ClientSession() as sess:
+            client = PartialRolloutClient(
+                f"http://127.0.0.1:{mport}", sess, chunk_tokens=4,
+                retry=RetryPolicy(max_attempts=2, base_delay_secs=0.01),
+                request_class="interactive",
+            )
+            res = await client.generate_one(
+                [2, 3], GenerationHyperparameters(max_new_tokens=4)
+            )
+        assert res.output_ids == [7, 1]
+        assert client.n_failovers == 0 and client.n_abandoned == 0
+        assert state["n"] == 3
+        await gen_runner.cleanup()
+        await mgr_runner.cleanup()
+
+    asyncio.run(main())
+
+
+@pytest.mark.serving
+@pytest.mark.timeout(120)
+def test_client_clamps_oversized_retry_after_to_budget():
+    """The server-supplied Retry-After is operator-set and unbounded; one
+    oversized hint must not sleep a rollout past the no_server_wait_secs
+    abandonment ceiling. With retry_after=3600 and a 0.2 s budget the
+    client abandons in well under a second."""
+    from aiohttp import web
+
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.base.retry import RetryPolicy
+    from areal_tpu.system.partial_rollout import (
+        GenerationAbandonedError,
+        PartialRolloutClient,
+    )
+
+    async def always_429(request):
+        return web.json_response(
+            {"ok": False, "reason": "admission", "retry_after": 3600.0},
+            status=429,
+        )
+
+    async def main():
+        import aiohttp
+
+        app = web.Application()
+        app.router.add_post("/generate", always_429)
+        gen_runner = web.AppRunner(app)
+        await gen_runner.setup()
+        gport = network.find_free_port()
+        await web.TCPSite(gen_runner, "127.0.0.1", gport).start()
+        gurl = f"http://127.0.0.1:{gport}"
+
+        mgr_app = web.Application()
+
+        async def sched(request):
+            return web.json_response({"url": gurl, "version": 0})
+
+        async def ok(request):
+            return web.json_response({"ok": True})
+
+        mgr_app.router.add_post("/schedule_request", sched)
+        mgr_app.router.add_post("/release", ok)
+        mgr_app.router.add_post("/renew", ok)
+        mgr_runner = web.AppRunner(mgr_app)
+        await mgr_runner.setup()
+        mport = network.find_free_port()
+        await web.TCPSite(mgr_runner, "127.0.0.1", mport).start()
+
+        async with aiohttp.ClientSession() as sess:
+            client = PartialRolloutClient(
+                f"http://127.0.0.1:{mport}", sess, chunk_tokens=4,
+                retry=RetryPolicy(max_attempts=2, base_delay_secs=0.01),
+                no_server_wait_secs=0.2,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(GenerationAbandonedError):
+                await client.generate_one(
+                    [2, 3], GenerationHyperparameters(max_new_tokens=4)
+                )
+            assert time.monotonic() - t0 < 5.0
+        assert client.n_abandoned == 1 and client.n_failovers == 0
+        await gen_runner.cleanup()
+        await mgr_runner.cleanup()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------ config surface
+
+
+@pytest.mark.serving
+def test_serving_config_cli_overrides():
+    from areal_tpu.api import cli_args as CA
+
+    cfg = CA.BaseExperimentConfig()
+    CA.apply_overrides(cfg, [
+        "serving.enabled=true",
+        "serving.chunk_buckets=8,16",
+        "serving.queue_limit_interactive=7",
+        "serving.max_compiled_shapes=32",
+    ])
+    assert cfg.serving.enabled is True
+    assert cfg.serving.chunk_buckets == [8, 16]
+    assert cfg.serving.queue_limit_interactive == 7
+    d = CA.to_yaml_dict(cfg)
+    assert d["serving"]["max_compiled_shapes"] == 32

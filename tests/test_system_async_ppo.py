@@ -38,6 +38,14 @@ def _tel():
     return TelemetryConfig(**TEL)
 
 
+def _serving():
+    from areal_tpu.api.train_config import ServingConfig
+
+    # Serving engine on (docs/serving.md): the fleet carries rollout
+    # traffic AND the interactive probe below through one server.
+    return ServingConfig(enabled=True)
+
+
 def _gen_fleet_main(nr_root, data_path, realloc_dir):
     import jax
 
@@ -69,6 +77,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
             GenerationServerConfig(
                 experiment=EXP, trial=TRIAL, chunk_tokens=4,
                 prompt_bucket=16, batch_window_ms=2, telemetry=_tel(),
+                serving=_serving(),
             ),
             cfg, params,
         )
@@ -198,6 +207,62 @@ def test_async_ppo_full_loop(tmp_path):
                         args=(nr_root, data_path, realloc_dir), daemon=True)
     trainer.start()
     fleet.start()
+
+    # Mixed-traffic probe (docs/serving.md): while the master drives the
+    # rollout workload, a separate thread fires INTERACTIVE requests
+    # through the manager's class-aware scheduler at the same fleet —
+    # one fleet concurrently serving both classes, end to end.
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    interactive_results = []
+
+    def _interactive_probe():
+        from areal_tpu.base import names as _names
+
+        def post(url, payload):
+            req = urllib.request.Request(
+                url, data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return _json.loads(r.read().decode())
+
+        try:
+            murl = name_resolve.wait(
+                _names.gen_server_manager(EXP, TRIAL), timeout=120
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced via the assert
+            interactive_results.append({"error": str(e)})
+            return
+        for i in range(3):
+            # Per-attempt isolation: urlopen raises HTTPError on any
+            # non-2xx (a transient 429/503 while the fleet churns), and
+            # one failed attempt must not kill the remaining ones.
+            try:
+                route = post(f"{murl}/schedule_request",
+                             {"class": "interactive"})
+                if not route.get("url"):
+                    time.sleep(0.2)
+                    continue
+                out = post(f"{route['url']}/generate", {
+                    "prompt_ids": [7, 8, 9, 10 + i],
+                    "class": "interactive",
+                    "rid": f"interactive{i}",
+                    "gconfig": {"max_new_tokens": 4, "greedy": True},
+                    "max_tokens": 4,
+                })
+                post(f"{murl}/release", {"lease_id": route.get("lease_id"),
+                                         "url": route["url"]})
+                interactive_results.append(out)
+            except Exception as e:  # noqa: BLE001 — surfaced via the assert
+                interactive_results.append({"error": str(e)})
+                time.sleep(0.2)
+
+    probe = threading.Thread(target=_interactive_probe, daemon=True)
+    probe.start()
     try:
         from areal_tpu.system.master_worker import (
             ExperimentSaveEvalControl,
@@ -235,10 +300,11 @@ def test_async_ppo_full_loop(tmp_path):
         kinds = {r["worker"].split(":")[0] for r in recs}
         assert len(kinds) >= 3, kinds
         assert any(r["spans"] for r in recs)
+        # the interactive probe must have finished BEFORE the scrapes
+        # below — its histograms/counters are part of what we assert on.
+        probe.join(timeout=60)
         # the generation server (fleet process still alive) serves valid
         # Prometheus text with weight-version + inflight gauges
-        import urllib.request
-
         (gurl,) = name_resolve.get_subtree(
             names.gen_server_root(EXP, TRIAL)
         )
@@ -254,6 +320,19 @@ def test_async_ppo_full_loop(tmp_path):
         with urllib.request.urlopen(f"{murl}/metrics", timeout=10) as r:
             mprom = r.read().decode()
         assert "areal_gsmgr_healthy_servers 1" in mprom
+        # --- mixed traffic proven end to end (docs/serving.md) ---
+        ok_interactive = [
+            r for r in interactive_results if r.get("output_ids")
+        ]
+        assert ok_interactive, interactive_results
+        # per-class latency SLO histograms present in telemetry output:
+        # the interactive probe AND the bulk rollout class both appear.
+        assert "areal_serving_interactive_ttfc_secs_bucket" in prom
+        assert "areal_serving_rollout_queue_wait_secs_bucket" in prom
+        assert "areal_serving_compiled_shapes" in prom
+        assert "areal_genserver_kv_states" in prom
+        # the manager routed a class-aware interactive lease
+        assert "areal_gsmgr_scheduled_interactive_total" in mprom
     finally:
         for p in (trainer, fleet):
             if p.is_alive():

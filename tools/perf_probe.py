@@ -8,6 +8,14 @@ Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
   scrape <url>                        GET a worker's /metrics (Prometheus
                                       text or JSON) and pretty-print it
+  decode-bench <server_url> [n_requests] [max_tokens]
+                                      drive a LIVE generation server with
+                                      a mixed-class synthetic workload
+                                      (rollout/interactive/eval) and
+                                      report tokens/s, per-class latency,
+                                      queue depth, and the distinct
+                                      compiled-shape count (VERDICT #9,
+                                      docs/serving.md)
   profile-trigger <exp> <trial> <dir> [secs]
                                       ask the live trainer for an
                                       on-demand jax.profiler capture
@@ -68,6 +76,78 @@ def scrape(url: str) -> None:
         print(f"  {k:<{w}}  {v if isinstance(v, str) else f'{v:g}'}")
 
 
+def decode_bench(server_url: str, n_requests: int = 24,
+                 max_tokens: int = 32) -> None:
+    """Decode-throughput probe against a LIVE generation server (the
+    probe half of VERDICT #9): fire a mixed-class synthetic workload with
+    randomized prompt lengths/budgets, then report client-side tokens/s
+    + per-class latency and the server's own queue/shape counters from
+    ``/metrics.json``. jax-free: run it from any host that can reach the
+    server."""
+    import asyncio
+    import json as _json
+    import random
+    import time as _time
+    import urllib.request
+
+    import aiohttp
+
+    url = server_url if server_url.startswith("http") \
+        else f"http://{server_url}"
+    rng = random.Random(0)
+    classes = ["rollout", "rollout", "interactive", "eval"]
+
+    async def one(session, i):
+        cls = classes[i % len(classes)]
+        plen = rng.randint(4, 48)
+        budget = rng.randint(4, max_tokens)
+        body = {
+            "prompt_ids": [rng.randint(2, 90) for _ in range(plen)],
+            "class": cls,
+            "rid": f"bench{i}",
+            "gconfig": {"max_new_tokens": budget, "greedy": False},
+            "max_tokens": budget,
+        }
+        t0 = _time.monotonic()
+        async with session.post(f"{url}/generate", json=body) as r:
+            if r.status != 200:
+                # 429 = admission backpressure, 413 = over capacity, 5xx =
+                # server trouble: all reported, none kill the bench.
+                return f"{cls}:http{r.status}", None, 0
+            out = await r.json()
+        return cls, _time.monotonic() - t0, len(out["output_ids"])
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            t0 = _time.monotonic()
+            res = await asyncio.gather(
+                *[one(session, i) for i in range(n_requests)]
+            )
+            return res, _time.monotonic() - t0
+
+    results, wall = asyncio.run(run())
+    tokens = sum(n for _, _, n in results)
+    errs = sorted(c for c, dt, _ in results if dt is None)
+    print(f"[decode-bench] {n_requests} requests "
+          f"({len(errs)} non-200: {', '.join(errs) or 'none'}), "
+          f"{tokens} tokens in {wall:.2f}s -> "
+          f"{tokens / max(wall, 1e-9):,.0f} tok/s")
+    for cls in ("interactive", "eval", "rollout"):
+        lats = [dt for c, dt, _ in results if c == cls and dt is not None]
+        if lats:
+            lats.sort()
+            print(f"[decode-bench] {cls:<12} n={len(lats)} "
+                  f"mean={sum(lats) / len(lats) * 1e3:.0f}ms "
+                  f"p95={lats[int(0.95 * (len(lats) - 1))] * 1e3:.0f}ms")
+    with urllib.request.urlopen(f"{url}/metrics.json", timeout=10) as r:
+        m = _json.loads(r.read().decode())
+    print(f"[decode-bench] server: tokens_per_sec={m['tokens_per_sec']:.0f} "
+          f"compiled_shapes={m.get('compiled_shapes')} "
+          f"kv_states={m.get('kv_states')} "
+          f"queue_depth={m.get('queue_depth')} "
+          f"prefill_tokens={m.get('prefill_tokens')}")
+
+
 def profile_trigger(experiment: str, trial: str, out_dir: str,
                     secs: float = 5.0) -> None:
     from areal_tpu.base import telemetry
@@ -86,13 +166,19 @@ def profile_status(experiment: str, trial: str) -> None:
 
 
 def _dispatch_fleet_commands(argv) -> bool:
-    if not argv or argv[0] not in ("scrape", "profile-trigger",
-                                   "profile-status"):
+    if not argv or argv[0] not in ("scrape", "decode-bench",
+                                   "profile-trigger", "profile-status"):
         return False
     cmd = argv[0]
     try:
         if cmd == "scrape":
             scrape(argv[1])
+        elif cmd == "decode-bench":
+            decode_bench(
+                argv[1],
+                int(argv[2]) if len(argv) > 2 else 24,
+                int(argv[3]) if len(argv) > 3 else 32,
+            )
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
                             float(argv[4]) if len(argv) > 4 else 5.0)

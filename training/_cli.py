@@ -51,6 +51,9 @@ def main(experiment_name: str, default_cls) -> None:
     if flags["config"]:
         CA.load_yaml(cfg, flags["config"])
     CA.apply_overrides(cfg, overrides)
+    # Fail bad modes (e.g. the descoped mode=ray) at parse time, while
+    # the operator is still at the command line.
+    CA.validate_config(cfg)
     cfg.resolve_trial_name()
 
     from areal_tpu.base import logging
