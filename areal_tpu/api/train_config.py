@@ -75,6 +75,21 @@ class TelemetryConfig:
     http_port: int = 0
     # Span buffer bound per process between flushes (oldest drop first).
     max_buffered_spans: int = 4096
+    # ---- sample-lineage tracing + flight recorder ----
+    # Stitched end-to-end traces (one JSON line per trained sample);
+    # defaults next to telemetry.jsonl when unset.
+    traces_path: Optional[str] = None
+    # How long a terminal span waits for sibling workers' slower span
+    # flushes before the trace is stitched. Should exceed
+    # flush_interval_secs; lower it together with the flush interval.
+    stitch_grace_secs: float = 5.0
+    # Per-worker crash-evidence ring of recent span/event records
+    # (0 disables the ring entirely).
+    flight_recorder_len: int = 512
+    # Where flight_<worker>.jsonl dumps land on crash/SIGTERM/eviction.
+    # None: no crash hooks are installed (on-demand dumps still work —
+    # the trigger request carries its own directory).
+    flight_dir: Optional[str] = None
 
 
 @dataclasses.dataclass

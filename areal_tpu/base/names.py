@@ -101,6 +101,20 @@ def profiler_status(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/profiler_status"
 
 
+def telemetry_http(experiment: str, trial: str) -> str:
+    """HTTP URL of the aggregator's merged-fleet Prometheus endpoint
+    (present iff telemetry.http_port > 0) — lets jax-free tools reach the
+    merged scrape without knowing the port (tools/perf_probe.py)."""
+    return f"{_base(experiment, trial)}/telemetry_http"
+
+
+def flight_dump_trigger(experiment: str, trial: str) -> str:
+    """On-demand flight-recorder dump request: a JSON {dir, nonce} an
+    operator writes (tools/perf_probe.py flight-dump); every worker's
+    TelemetryPusher acts on it once per nonce (base/telemetry.py)."""
+    return f"{_base(experiment, trial)}/flight_dump_trigger"
+
+
 def metric_server(experiment: str, trial: str, group: str, index: str) -> str:
     return f"{_base(experiment, trial)}/metrics/{group}/{index}"
 

@@ -43,14 +43,19 @@ sockets, no HTTP servers, no span allocation.
 
 from __future__ import annotations
 
+import collections
 import contextvars
 import dataclasses
 import itertools
 import json
 import os
 import pickle
+import signal
+import sys
 import threading
 import time
+import uuid
+import weakref
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -73,6 +78,153 @@ _CUR_SPAN: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
 
 
 # --------------------------------------------------------------------------
+# cross-worker trace context (sample-lineage tracing)
+# --------------------------------------------------------------------------
+#
+# Dapper-style propagation: a rollout worker ORIGINATES a trace when a
+# prompt is admitted; every RPC that serves that sample carries the
+# (trace_id, parent span ref) pair — an HTTP header on /generate and
+# /allocate_rollout, an optional ``_trace`` dict on the rollout→trainer
+# push stream — and every receiving worker's spans link back to the
+# remote parent. Span ids are only unique per process, so a remote
+# parent is referenced by its GLOBAL ref ``worker_kind:worker_index/
+# span_id`` — exactly the key the aggregator files the span under,
+# which is what lets the master-side TraceStitcher join the pieces.
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """The portable part of a trace: which trace, and which remote span
+    to hang the next child off."""
+
+    trace_id: str
+    parent_span: Optional[str] = None  # global ref "kind:idx/span_id"
+
+    def as_dict(self) -> Dict[str, str]:
+        d = {"trace_id": self.trace_id}
+        if self.parent_span:
+            d["parent_span"] = self.parent_span
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> Optional["TraceContext"]:
+        tid = d.get("trace_id")
+        if not tid:
+            return None
+        return cls(trace_id=str(tid),
+                   parent_span=d.get("parent_span") or None)
+
+
+_CUR_TRACE: contextvars.ContextVar[Optional[TraceContext]] = (
+    contextvars.ContextVar("areal_tpu_cur_trace", default=None)
+)
+
+# Single wire header for both directions; value is "<trace_id>;<parent>"
+# (the parent half may be empty). One header keeps the disabled-path
+# contract trivially checkable: no trace ⇒ the header dict is empty ⇒
+# the request bytes are identical to a build without tracing.
+TRACE_HEADER = "X-Areal-Trace"
+TRACE_FIELD = "_trace"  # optional key on pushed sample dicts (streams.py)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _CUR_TRACE.get()
+
+
+@contextmanager
+def trace_scope(ctx: Optional[TraceContext]):
+    """Adopt ``ctx`` (e.g. extracted from an incoming request) for the
+    calling context; ``None`` is a no-op so call sites never branch."""
+    if ctx is None:
+        yield None
+        return
+    token = _CUR_TRACE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CUR_TRACE.reset(token)
+
+
+@contextmanager
+def start_trace(trace_id: Optional[str] = None):
+    """Originate a new trace (rollout worker, at prompt admission). With
+    telemetry disabled this allocates nothing and yields None — spans
+    stay un-traced and inject() stays empty."""
+    if not _GLOBAL.enabled:
+        yield None
+        return
+    ctx = TraceContext(trace_id=trace_id or new_trace_id())
+    token = _CUR_TRACE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CUR_TRACE.reset(token)
+
+
+def _current_parent_ref(worker_ref: str,
+                        ctx: TraceContext) -> Optional[str]:
+    """The span ref a downstream child should link to: the caller's open
+    span if there is one (qualified by this worker's identity), else
+    whatever remote parent the context already carried."""
+    sid = _CUR_SPAN.get()
+    if sid is not None and worker_ref:
+        return f"{worker_ref}/{sid}"
+    return ctx.parent_span
+
+
+def inject_headers() -> Dict[str, str]:
+    """Trace context → HTTP headers. Empty dict when telemetry is
+    disabled or no trace is active, so request bytes are unchanged."""
+    ctx = _CUR_TRACE.get()
+    if ctx is None or not _GLOBAL.enabled:
+        return {}
+    parent = _current_parent_ref(_GLOBAL.worker_ref, ctx) or ""
+    return {TRACE_HEADER: f"{ctx.trace_id};{parent}"}
+
+
+def extract_headers(headers) -> Optional[TraceContext]:
+    """HTTP headers → TraceContext (None when absent/malformed)."""
+    try:
+        raw = headers.get(TRACE_HEADER)
+    except Exception:  # noqa: BLE001 — header container without .get
+        return None
+    if not raw:
+        return None
+    tid, _, parent = str(raw).partition(";")
+    if not tid:
+        return None
+    return TraceContext(trace_id=tid, parent_span=parent or None)
+
+
+def inject_payload(obj: Any) -> Any:
+    """Attach the active trace context to a ZMQ payload dict under
+    ``_trace``. Returns ``obj`` untouched (same object, same bytes on
+    the wire) when telemetry is disabled, no trace is active, or the
+    payload is not a dict."""
+    ctx = _CUR_TRACE.get()
+    if ctx is None or not _GLOBAL.enabled or not isinstance(obj, dict):
+        return obj
+    parent = _current_parent_ref(_GLOBAL.worker_ref, ctx)
+    obj[TRACE_FIELD] = TraceContext(ctx.trace_id, parent).as_dict()
+    return obj
+
+
+def extract_payload(obj: Any) -> Optional[TraceContext]:
+    """Pop ``_trace`` off a payload dict (backward-compatible: absent
+    field → None, payload otherwise untouched)."""
+    if not isinstance(obj, dict):
+        return None
+    d = obj.pop(TRACE_FIELD, None)
+    if not isinstance(d, dict):
+        return None
+    return TraceContext.from_dict(d)
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -85,15 +237,64 @@ class Span:
     t_start: float  # wall clock (time.time)
     dur_secs: float
     attrs: Dict[str, Any]
+    # Sample-lineage tracing: which trace this span belongs to, and (for
+    # a local root adopted from another worker) the remote parent's
+    # global ref. None/absent for un-traced spans — the jsonl record
+    # stays byte-identical to the pre-tracing format for them.
+    trace_id: Optional[str] = None
+    remote_parent: Optional[str] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "name": self.name, "span_id": self.span_id,
             "parent_id": self.parent_id,
             "t_start": round(self.t_start, 6),
             "dur_secs": round(self.dur_secs, 6),
             "attrs": self.attrs,
         }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.remote_parent is not None:
+            d["remote_parent"] = self.remote_parent
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of the most recent span/event records, kept OUTSIDE
+    the flush-drained span buffer so the last moments before a crash are
+    always reconstructible. Dumped to ``flight_<worker>.jsonl`` on
+    SIGTERM/uncaught exception (when ``flight_dir`` is configured), on
+    operator request (``names.flight_dump_trigger``, mirroring the
+    profiler-trigger pattern), or explicitly (manager eviction path)."""
+
+    def __init__(self, maxlen: int = 512):
+        self._lock = threading.Lock()
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=maxlen
+        )
+
+    def record(self, kind: str, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append({"kind": kind, **rec})
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, path: str, reason: str = "") -> int:
+        """Write the ring (oldest first) + a terminal marker record.
+        Signal-safe enough: plain buffered writes, no locks held while
+        touching the filesystem beyond the snapshot copy."""
+        recs = self.snapshot()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.write(json.dumps({
+                "kind": "dump", "reason": reason,
+                "time": round(time.time(), 6), "n_records": len(recs),
+            }) + "\n")
+        return len(recs)
 
 
 class _Histogram:
@@ -140,6 +341,9 @@ class TelemetryRegistry:
         self._spans: List[Span] = []
         self.max_spans = max_spans
         self.dropped_spans = 0
+        # Optional crash-evidence ring (set by Telemetry when enabled):
+        # finished spans/events are mirrored here, never drained.
+        self.flight: Optional[FlightRecorder] = None
 
     # ---- metrics ----
 
@@ -161,10 +365,30 @@ class TelemetryRegistry:
 
     # ---- spans ----
 
+    def _store_span(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self._spans.pop(0)
+                self.dropped_spans += 1
+                # First-class drop counter (Prometheus:
+                # areal_telemetry_spans_dropped_total) so truncated
+                # traces are detectable, not silent. Direct dict write:
+                # inc() would re-take the held lock.
+                self._counters["telemetry/spans_dropped"] = (
+                    self._counters.get("telemetry/spans_dropped", 0.0) + 1
+                )
+            self._spans.append(s)
+        if self.flight is not None:
+            self.flight.record("span", s.as_dict())
+        # Every span doubles as a duration histogram point, so the
+        # aggregate view exists even when span volume forces drops.
+        self.observe(f"{s.name}/secs", s.dur_secs)
+
     @contextmanager
     def span(self, name: str, **attrs):
         sid = next(_span_ids)
         parent = _CUR_SPAN.get()
+        trace = _CUR_TRACE.get()
         token = _CUR_SPAN.set(sid)
         t_wall = time.time()
         t0 = time.monotonic()
@@ -175,14 +399,42 @@ class TelemetryRegistry:
             s = Span(name=name, span_id=sid, parent_id=parent,
                      t_start=t_wall, dur_secs=time.monotonic() - t0,
                      attrs=attrs)
-            with self._lock:
-                if len(self._spans) >= self.max_spans:
-                    self._spans.pop(0)
-                    self.dropped_spans += 1
-                self._spans.append(s)
-            # Every span doubles as a duration histogram point, so the
-            # aggregate view exists even when span volume forces drops.
-            self.observe(f"{name}/secs", s.dur_secs)
+            if trace is not None:
+                s.trace_id = trace.trace_id
+                if parent is None:
+                    # Local root of a distributed trace: link to the
+                    # remote span that caused this work.
+                    s.remote_parent = trace.parent_span
+            self._store_span(s)
+
+    def add_span(self, name: str, t_start: float, dur_secs: float,
+                 trace: Optional[TraceContext] = None,
+                 parent_id: Optional[int] = None, **attrs) -> int:
+        """Record a span whose window was measured by the caller (queue
+        waits, per-request shares of a batched decode, terminal
+        trained-sample marks). ``t_start`` is wall-clock (time.time).
+        Parents under the caller's open span when there is one; a local
+        root instead links to the trace's remote parent. Returns the
+        span id so callers can chain children off it."""
+        sid = next(_span_ids)
+        if parent_id is None:
+            parent_id = _CUR_SPAN.get()
+        s = Span(name=name, span_id=sid, parent_id=parent_id,
+                 t_start=t_start, dur_secs=float(dur_secs), attrs=attrs)
+        if trace is not None:
+            s.trace_id = trace.trace_id
+            if parent_id is None:
+                s.remote_parent = trace.parent_span
+        self._store_span(s)
+        return sid
+
+    def event(self, name: str, **attrs) -> None:
+        """Point-in-time record (failover fired, 429 backoff, eviction):
+        a zero-duration span — it rides the same flush/stitch path and
+        lands in the flight ring — under the ACTIVE trace context and
+        nested below the caller's open span (if any)."""
+        self.add_span(name, time.time(), 0.0, trace=_CUR_TRACE.get(),
+                      parent_id=_CUR_SPAN.get(), **attrs)
 
     # ---- export ----
 
@@ -220,7 +472,12 @@ def _prom_labels(labels: Optional[Dict[str, str]],
         return ""
 
     def esc(v) -> str:
-        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+        # Exposition-format escaping for label values: backslash FIRST
+        # (or it would double-escape the others), then quote, then
+        # newline — an unescaped newline splits the sample line in two
+        # and the scraper rejects the whole exposition.
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
 
     inner = ",".join(
         f'{_prom_name(k)}="{esc(v)}"' for k, v in sorted(merged.items())
@@ -305,6 +562,9 @@ class TelemetryPusher:
         self.worker_index = worker_index
         self.flush_interval_secs = flush_interval_secs
         self._key = names.telemetry_aggregator(experiment, trial)
+        self._flight_key = names.flight_dump_trigger(experiment, trial)
+        self._flight_nonce: Optional[str] = None  # last handled trigger
+        self._t_start_wall = time.time()  # gates stale-trigger replay
         self._sock = None
         self._flush_lock = threading.Lock()  # socket use is single-file
         self._pending: Optional[bytes] = None  # unsent snapshot (backlog)
@@ -361,10 +621,45 @@ class TelemetryPusher:
                 return False
         return True
 
+    def check_flight_trigger(self) -> Optional[str]:
+        """On-demand flight dump (profiler-trigger pattern, but fan-out:
+        the flag is NOT consumed — every worker acts on it once, keyed by
+        its nonce, so one trigger dumps the whole fleet's rings). Returns
+        the written path when this call dumped."""
+        if self.registry.flight is None:
+            return None
+        try:
+            raw = name_resolve.get(self._flight_key)
+        except Exception:  # noqa: BLE001 — no trigger pending
+            return None
+        try:
+            req = json.loads(raw)
+            nonce = str(req.get("nonce", ""))
+            if not nonce or nonce == self._flight_nonce:
+                return None
+            self._flight_nonce = nonce
+            if float(req.get("time", 0.0)) < self._t_start_wall:
+                # The flag predates this worker (it is deliberately not
+                # consumed so the whole fleet can act on it) — a freshly
+                # (re)started worker must not replay it and overwrite
+                # the incident evidence with its near-empty ring.
+                return None
+            path = os.path.join(
+                req["dir"],
+                f"flight_{self.worker_kind}{self.worker_index}.jsonl",
+            )
+            n = self.registry.flight.dump(path, reason=f"trigger:{nonce}")
+            logger.info(f"flight dump ({n} records) -> {path}")
+            return path
+        except Exception as e:  # noqa: BLE001 — telemetry never kills
+            logger.warning(f"flight dump trigger failed: {e}")
+            return None
+
     def _loop(self) -> None:
         while not self._closing.wait(self.flush_interval_secs):
             try:
                 self.flush()
+                self.check_flight_trigger()
             except Exception as e:  # noqa: BLE001 — telemetry never kills
                 logger.warning(f"telemetry flush failed: {e}")
 
@@ -387,6 +682,178 @@ class TelemetryPusher:
 
 
 # --------------------------------------------------------------------------
+# trace stitching (master side)
+# --------------------------------------------------------------------------
+
+# prompt→trained latencies live on a longer scale than RPCs.
+E2E_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+               120.0, 300.0, 600.0)
+
+# Span name → stage of the measured staleness decomposition. The
+# "train" stage is the triggering terminal span alone (a group's other
+# samples have their own terminals), and "train_wait" is derived
+# (terminal start − rollout end), so neither lives in this map.
+STAGE_OF_SPAN = {
+    "rollout/gate": "gate",
+    "rollout/generate": "generate",
+    "genserver/queue_wait": "queue",
+}
+TERMINAL_SPAN = "trainer/train_sample"
+TRACE_STAGES = ("generate", "queue", "gate", "train_wait", "train")
+
+
+@dataclasses.dataclass
+class _TraceEntry:
+    spans: List[Dict] = dataclasses.field(default_factory=list)
+    stitched: bool = False  # at least one terminal already processed
+
+
+class TraceStitcher:
+    """Joins spans by trace_id across workers into end-to-end sample
+    timelines.
+
+    Fed from the aggregator's ingest path; spans carrying a ``trace_id``
+    are buffered per trace (bounded LRU — a trace whose terminal span
+    never arrives, e.g. an abandoned rollout, eventually falls off and
+    is counted in ``trace/unstitched_evicted``; traces that already
+    stitched age out silently). A TERMINAL span (``trainer/train_sample``)
+    schedules a stitch after ``grace_secs`` — sibling workers flush on
+    their own ``flush_interval_secs`` cadence, so stitching immediately
+    would record a truncated timeline whenever the trainer's snapshot
+    outruns the rollout worker's. ``tick()`` (called from the
+    aggregator's ingest loop, and with ``force=True`` on close) performs
+    the due stitches: one record appended to ``traces.jsonl`` PER
+    TRAINED SAMPLE and the derived first-class metrics — prompt→trained
+    e2e latency and the per-stage generate/queue/gate/train-wait/train
+    breakdown, one observation per trained sample — observed into
+    ``registry`` (exported by the aggregator's /metrics).
+    ``trace/stitched`` counts unique completed traces (prompts);
+    per-sample multiplicity is visible as the e2e histogram count."""
+
+    def __init__(self, traces_path: Optional[str],
+                 registry: Optional[TelemetryRegistry] = None,
+                 max_traces: int = 1024, grace_secs: float = 5.0):
+        self.registry = registry or TelemetryRegistry()
+        self.max_traces = max_traces
+        self.grace_secs = grace_secs
+        self._lock = threading.Lock()
+        self._traces: "collections.OrderedDict[str, _TraceEntry]" = (
+            collections.OrderedDict()
+        )
+        # (due_monotonic, trace_id, terminal span) awaiting their grace.
+        self._deferred: List[Tuple[float, str, Dict]] = []
+        self._file = None
+        if traces_path:
+            os.makedirs(os.path.dirname(traces_path) or ".", exist_ok=True)
+            self._file = open(traces_path, "a", buffering=1)
+
+    def feed(self, worker: str, spans: Sequence[Dict[str, Any]]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid:
+                    continue
+                rec = {**s, "worker": worker}
+                entry = self._traces.get(tid)
+                if entry is None:
+                    entry = self._traces[tid] = _TraceEntry()
+                self._traces.move_to_end(tid)
+                entry.spans.append(rec)
+                if s.get("name") == TERMINAL_SPAN:
+                    self._deferred.append(
+                        (now + self.grace_secs, tid, rec)
+                    )
+            scanned = 0
+            while (len(self._traces) > self.max_traces
+                   and scanned <= self.max_traces):
+                tid, old = self._traces.popitem(last=False)
+                scanned += 1
+                if not old.stitched and any(
+                    d[1] == tid for d in self._deferred
+                ):
+                    # Terminal already arrived; its stitch is merely
+                    # waiting out the grace window — evicting now would
+                    # silently drop a COMPLETED trace. Keep it (at MRU)
+                    # until tick() stitches it.
+                    self._traces[tid] = old
+                    continue
+                if not old.stitched:
+                    # Only a trace that never saw a terminal span is a
+                    # loss signal (abandoned rollout / dropped spans);
+                    # completed traces aging out is normal turnover.
+                    self.registry.inc("trace/unstitched_evicted")
+        self.tick()
+
+    def tick(self, force: bool = False) -> None:
+        """Stitch every deferred terminal whose grace elapsed (all of
+        them with ``force=True`` — shutdown must not drop stragglers)."""
+        now = time.monotonic()
+        with self._lock:
+            due = [d for d in self._deferred if force or d[0] <= now]
+            if not due:
+                return
+            self._deferred = [d for d in self._deferred
+                              if not (force or d[0] <= now)]
+        for _, tid, term in due:
+            self._stitch(tid, term)
+
+    def _stitch(self, trace_id: str, terminal: Dict[str, Any]) -> None:
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return  # evicted before its grace elapsed
+            first = not entry.stitched
+            entry.stitched = True
+            spans = sorted(entry.spans, key=lambda s: s["t_start"])
+        root_start = min(s["t_start"] for s in spans)
+        e2e = max(terminal["t_start"] + terminal["dur_secs"] - root_start,
+                  0.0)
+        stages = {k: 0.0 for k in TRACE_STAGES}
+        # "train" is THIS sample's terminal alone — a group's sibling
+        # samples stitch separately with their own terminals.
+        stages["train"] = terminal["dur_secs"]
+        rollout_end = None
+        for s in spans:
+            stage = STAGE_OF_SPAN.get(s["name"])
+            if stage:
+                stages[stage] += s["dur_secs"]
+            if s["name"] == "rollout/rollout":
+                rollout_end = s["t_start"] + s["dur_secs"]
+        if rollout_end is not None:
+            # Time between the sample leaving the rollout worker and the
+            # trainer step that consumed it: the stream + buffer + MFC
+            # gate wait — the part of staleness training speed controls.
+            stages["train_wait"] = max(
+                terminal["t_start"] - rollout_end, 0.0
+            )
+        r = self.registry
+        if first:
+            r.inc("trace/stitched")  # unique completed traces
+        r.observe("trace/e2e_secs", e2e, buckets=E2E_BUCKETS)
+        for k, v in stages.items():
+            r.observe(f"trace/stage_{k}_secs", v, buckets=E2E_BUCKETS)
+        if self._file is not None:
+            self._file.write(json.dumps({
+                "trace_id": trace_id,
+                "sample_id": terminal.get("attrs", {}).get("sample_id"),
+                "weight_version": terminal.get("attrs", {})
+                                          .get("weight_version"),
+                "t_start": round(root_start, 6),
+                "e2e_secs": round(e2e, 6),
+                "stages": {k: round(v, 6) for k, v in stages.items()},
+                "workers": sorted({s["worker"] for s in spans}),
+                "spans": spans,
+            }) + "\n")
+
+    def close(self) -> None:
+        self.tick(force=True)
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# --------------------------------------------------------------------------
 # aggregator (master side)
 # --------------------------------------------------------------------------
 
@@ -399,7 +866,9 @@ class TelemetryAggregator:
 
     def __init__(self, experiment: str, trial: str,
                  jsonl_path: Optional[str] = None,
-                 metric_writer=None, http_port: int = 0):
+                 metric_writer=None, http_port: int = 0,
+                 traces_path: Optional[str] = None,
+                 stitch_grace_secs: float = 5.0):
         import zmq
 
         self.jsonl_path = jsonl_path
@@ -407,6 +876,18 @@ class TelemetryAggregator:
         self._seq = 0
         self.state: Dict[str, Dict[str, Any]] = {}
         self._state_lock = threading.Lock()
+        self._experiment, self._trial = experiment, trial
+        # Sample-lineage stitching: spans with a trace_id are joined into
+        # traces.jsonl (default: next to telemetry.jsonl) and the derived
+        # e2e/stage histograms live in the aggregator's OWN registry,
+        # exported under worker_kind="aggregator" on /metrics.
+        if traces_path is None and jsonl_path:
+            traces_path = os.path.join(
+                os.path.dirname(jsonl_path) or ".", "traces.jsonl"
+            )
+        self.traces_path = traces_path
+        self.stitcher = TraceStitcher(traces_path,
+                                      grace_secs=stitch_grace_secs)
         self._sock = zmq.Context.instance().socket(zmq.PULL)
         self._sock.setsockopt(zmq.RCVHWM, 4096)
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
@@ -446,6 +927,7 @@ class TelemetryAggregator:
             self.state[worker] = merged
             self._seq += 1
             seq = self._seq
+        self.stitcher.feed(worker, spans)
         if self._jsonl_file is not None:
             rec = {"worker": worker, **{
                 k: payload.get(k) for k in
@@ -468,9 +950,11 @@ class TelemetryAggregator:
     def _loop(self) -> None:
         while not self._closing.is_set():
             try:
-                if not self._sock.poll(100):
-                    continue
-                self._ingest(pickle.loads(self._sock.recv()))
+                if self._sock.poll(100):
+                    self._ingest(pickle.loads(self._sock.recv()))
+                # Deferred stitches come due on wall time, not on new
+                # snapshots — run them on idle poll timeouts too.
+                self.stitcher.tick()
             except Exception as e:  # noqa: BLE001 — aggregator must survive
                 if not self._closing.is_set():
                     logger.warning(f"telemetry ingest failed: {e}")
@@ -498,7 +982,13 @@ class TelemetryAggregator:
             fams.setdefault(name, {"kind": kind, "lines": []})["lines"] \
                 .append(line)
 
-        for worker, st in sorted(self.merged().items()):
+        rows = dict(self.merged())
+        # Derived trace metrics (prompt→trained e2e + stage breakdown)
+        # join the fleet exposition as their own pseudo-worker.
+        stitched = self.stitcher.registry.snapshot(reset=False)
+        if stitched["counters"] or stitched["hists"]:
+            rows["aggregator:0"] = stitched
+        for worker, st in sorted(rows.items()):
             kind, _, idx = worker.partition(":")
             labels = {"worker_kind": kind, "worker_index": idx}
             lab = _prom_labels(labels)
@@ -556,6 +1046,13 @@ class TelemetryAggregator:
         )
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name="telemetry-http").start()
+        # Advertise the merged endpoint so jax-free tools (perf_probe
+        # scrape <exp> <trial>) can find it without knowing the port.
+        self._http_key = names.telemetry_http(self._experiment, self._trial)
+        name_resolve.add(
+            self._http_key,
+            f"http://{network.gethostip()}:{port}", replace=True,
+        )
 
     def close(self) -> None:
         # ZMQ sockets are not thread-safe: stop the ingest thread BEFORE
@@ -577,10 +1074,15 @@ class TelemetryAggregator:
                 pass
             self._sock.close(linger=0)
         if self._http is not None:
+            try:
+                name_resolve.delete(self._http_key)
+            except Exception:  # noqa: BLE001 — already gone / repo reset
+                pass
             self._http.shutdown()
             self._http.server_close()
         if self._jsonl_file is not None:
             self._jsonl_file.close()
+        self.stitcher.close()
 
 
 # --------------------------------------------------------------------------
@@ -602,6 +1104,71 @@ class _NullSpanCtx:
 
 _NULL_SPAN = _NullSpanCtx()
 
+# Live enabled Telemetry instances in this process (the gen-fleet process
+# hosts several) — the crash hooks dump every ring at once.
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+_EXCEPTHOOK_INSTALLED = False
+_SIGTERM_INSTALLED = False
+
+
+def _dump_all_flight(reason: str) -> List[str]:
+    paths = []
+    for t in list(_LIVE):
+        p = t.flight_dump(reason=reason)
+        if p:
+            paths.append(p)
+    return paths
+
+
+def _install_crash_hooks() -> None:
+    """Chain a SIGTERM handler + sys.excepthook that dump every live
+    flight ring before the process dies. Installed only when a
+    ``flight_dir`` is configured — test processes and disabled runs never
+    have their signal disposition touched. The two halves latch
+    separately: a first install off the main thread (where
+    ``signal.signal`` raises) still gets excepthook coverage, and a later
+    main-thread install retries the signal half."""
+    global _EXCEPTHOOK_INSTALLED, _SIGTERM_INSTALLED
+    if not _EXCEPTHOOK_INSTALLED:
+        _EXCEPTHOOK_INSTALLED = True
+        prev_hook = sys.excepthook
+
+        def hook(tp, value, tb):
+            try:
+                _dump_all_flight(f"uncaught:{tp.__name__}: {value}")
+            except Exception:  # noqa: BLE001 — never mask the real crash
+                pass
+            prev_hook(tp, value, tb)
+
+        sys.excepthook = hook
+    if not _SIGTERM_INSTALLED:
+        try:
+            prev_term = signal.getsignal(signal.SIGTERM)
+
+            def on_term(signum, frame):
+                try:
+                    _dump_all_flight("sigterm")
+                except Exception:  # noqa: BLE001
+                    pass
+                if callable(prev_term):
+                    prev_term(signum, frame)
+                elif prev_term == signal.SIG_IGN:
+                    # The process deliberately ignored SIGTERM before;
+                    # dumping must not turn an ignored signal fatal.
+                    return
+                else:
+                    # Restore the default disposition and re-deliver so
+                    # the exit status still says "killed by SIGTERM".
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, on_term)
+            _SIGTERM_INSTALLED = True
+        except ValueError:
+            # Off the main thread: excepthook coverage only; a later
+            # main-thread Telemetry construction retries this half.
+            pass
+
 
 class Telemetry:
     """A (registry, pusher) bundle — the unit each worker owns.
@@ -618,7 +1185,18 @@ class Telemetry:
 
         cfg = cfg or TelemetryConfig(enabled=True)
         self.cfg = cfg
+        self.worker_kind = worker_kind
+        self.worker_index = worker_index
+        # Global span-ref prefix for cross-worker parent links: matches
+        # the key the aggregator files this worker's spans under.
+        self.worker_ref = f"{worker_kind}:{worker_index}"
         self.registry = TelemetryRegistry(max_spans=cfg.max_buffered_spans)
+        if getattr(cfg, "flight_recorder_len", 0) > 0:
+            self.registry.flight = FlightRecorder(cfg.flight_recorder_len)
+        self.flight_dir = getattr(cfg, "flight_dir", None)
+        _LIVE.add(self)
+        if self.flight_dir and self.registry.flight is not None:
+            _install_crash_hooks()
         self.pusher = (
             TelemetryPusher(
                 self.registry, experiment, trial, worker_kind, worker_index,
@@ -640,6 +1218,32 @@ class Telemetry:
     def span(self, name: str, **attrs):
         return self.registry.span(name, **attrs)
 
+    def add_span(self, name: str, t_start: float, dur_secs: float,
+                 trace: Optional[TraceContext] = None, **attrs) -> int:
+        return self.registry.add_span(name, t_start, dur_secs,
+                                      trace=trace, **attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self.registry.event(name, **attrs)
+
+    def flight_dump(self, out_dir: Optional[str] = None,
+                    reason: str = "") -> Optional[str]:
+        """Dump this worker's flight ring to
+        ``<dir>/flight_<kind><index>.jsonl``; None when no ring or no
+        directory is configured (never raises — crash-path safe)."""
+        d = out_dir or self.flight_dir
+        if d is None or self.registry.flight is None:
+            return None
+        path = os.path.join(
+            d, f"flight_{self.worker_kind}{self.worker_index}.jsonl"
+        )
+        try:
+            self.registry.flight.dump(path, reason=reason)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            logger.warning(f"flight dump failed: {e}")
+            return None
+        return path
+
     def snapshot(self, reset: bool = False) -> Dict[str, Any]:
         return self.registry.snapshot(reset=reset)
 
@@ -647,6 +1251,7 @@ class Telemetry:
         if self.pusher is not None:
             self.pusher.close()
             self.pusher = None
+        _LIVE.discard(self)
 
 
 class _NullTelemetry:
@@ -655,6 +1260,8 @@ class _NullTelemetry:
     enabled = False
     registry = None
     pusher = None
+    worker_ref = ""
+    flight_dir = None
 
     def inc(self, name: str, n: float = 1.0) -> None:
         pass
@@ -667,6 +1274,16 @@ class _NullTelemetry:
 
     def span(self, name: str, **attrs):
         return _NULL_SPAN
+
+    def add_span(self, name: str, t_start: float, dur_secs: float,
+                 trace=None, **attrs) -> int:
+        return 0
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def flight_dump(self, out_dir=None, reason: str = "") -> Optional[str]:
+        return None
 
     def snapshot(self, reset: bool = False) -> Dict[str, Any]:
         return {"counters": {}, "gauges": {}, "hists": {}, "spans": [],
@@ -723,6 +1340,29 @@ def observe(name: str, v: float, buckets=None) -> None:
 
 def span(name: str, **attrs):
     return _GLOBAL.span(name, **attrs)
+
+
+def add_span(name: str, t_start: float, dur_secs: float,
+             trace: Optional[TraceContext] = None, **attrs) -> int:
+    return _GLOBAL.add_span(name, t_start, dur_secs, trace=trace, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    _GLOBAL.event(name, **attrs)
+
+
+def request_flight_dump(experiment: str, trial: str, out_dir: str) -> str:
+    """Operator entry (tools/perf_probe.py flight-dump): ask EVERY worker
+    to dump its flight ring into ``out_dir``. Unlike the profiler trigger
+    the flag is not consumed — each worker's pusher acts once per nonce —
+    so one request snapshots the whole fleet. Returns the nonce."""
+    nonce = uuid.uuid4().hex[:12]
+    name_resolve.add(
+        names.flight_dump_trigger(experiment, trial),
+        json.dumps({"dir": out_dir, "nonce": nonce, "time": time.time()}),
+        replace=True,
+    )
+    return nonce
 
 
 # --------------------------------------------------------------------------

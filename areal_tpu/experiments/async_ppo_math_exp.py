@@ -64,7 +64,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 kv_bucket=shape_kw["kv_bucket"],
                 weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
                 serving=self.serving,
-                telemetry=self.telemetry,
+                telemetry=self._telemetry(),
             )
             for i in range(n_gen)
         ]
@@ -78,7 +78,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             max_concurrent_rollouts=self.max_concurrent_rollouts,
             schedule_policy=self.schedule_policy,
             realloc_dir=paths["realloc"],
-            telemetry=self.telemetry,
+            telemetry=self._telemetry(),
         )
         rollout_workers = [
             RolloutWorkerConfig(
@@ -97,7 +97,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 # Async-recovery skiplist lives next to the master's
                 # recover checkpoints (rollout_worker.ConsumedLog).
                 recover_dir=paths["recover"],
-                telemetry=self.telemetry,
+                telemetry=self._telemetry(),
             )
             for i in range(self.n_rollout_workers)
         ]

@@ -215,6 +215,20 @@ class PPOMATHConfig(BaseExperimentConfig):
             )
             return 10000
 
+    def _telemetry(self):
+        """``self.telemetry`` with ``flight_dir`` defaulted under the
+        run's log dir — crash/eviction flight dumps land next to
+        telemetry.jsonl unless the operator pointed them elsewhere."""
+        if not self.telemetry.enabled or self.telemetry.flight_dir:
+            return self.telemetry
+        import os
+
+        paths = C.experiment_paths(self)
+        return dataclasses.replace(
+            self.telemetry,
+            flight_dir=os.path.join(paths["log"], "flight"),
+        )
+
     def build_trainer_config(self, async_mode: bool = False):
         from areal_tpu.system.trainer_worker import (
             MFCRuntimeConfig,
@@ -321,7 +335,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             stream_dataset=async_mode,
             realloc_dir=paths["realloc"],
             weight_sync=self.weight_sync,
-            telemetry=self.telemetry,
+            telemetry=self._telemetry(),
         )
 
     def build_master_config(self, async_mode: bool = False):

@@ -14,7 +14,7 @@ import time
 from typing import Dict, Hashable, List, Optional, Sequence, Set
 
 from areal_tpu.api.data import SequenceSample
-from areal_tpu.base import logging
+from areal_tpu.base import logging, telemetry
 
 logger = logging.getLogger("system.buffer")
 
@@ -22,9 +22,15 @@ logger = logging.getLogger("system.buffer")
 @dataclasses.dataclass
 class _Slot:
     sample: SequenceSample  # metadata-only (data=None)
+    # Monotonic for LOCAL oldest-first ordering (immune to clock steps)…
     birth_time: float
     reads_left: int
     read_by: Set[str] = dataclasses.field(default_factory=set)
+    # …and wall-clock alongside, so cross-process stitched timelines
+    # (base/telemetry.TraceStitcher) can line the buffer dwell up against
+    # spans from other workers — monotonic values are meaningless across
+    # process boundaries.
+    birth_wall: float = 0.0
 
 
 class AsyncSequenceBuffer:
@@ -57,7 +63,7 @@ class AsyncSequenceBuffer:
                     raise RuntimeError("buffer overflow")
                 self._slots[sid] = _Slot(
                     sample=s.meta(), birth_time=time.monotonic(),
-                    reads_left=self._n_reads,
+                    reads_left=self._n_reads, birth_wall=time.time(),
                 )
             self._changed.notify_all()
 
@@ -97,10 +103,18 @@ class AsyncSequenceBuffer:
                 ids = ready()
                 if len(ids) >= n_seqs:
                     out = []
+                    now_wall = time.time()
                     for sid in ids[:n_seqs]:
                         slot = self._slots[sid]
                         slot.read_by.add(rpc_name)
                         slot.reads_left -= 1
+                        # Buffer dwell at selection (wall clock, so it
+                        # composes with the stitched cross-worker
+                        # timeline). No-op when telemetry is off.
+                        telemetry.observe(
+                            f"buffer/{rpc_name}_sample_age_secs",
+                            max(now_wall - slot.birth_wall, 0.0),
+                        )
                         out.append(slot.sample.meta())
                         if slot.reads_left <= 0:
                             del self._slots[sid]

@@ -96,10 +96,11 @@ class GenerationServerConfig:
 
 class _Pending:
     __slots__ = ("rid", "prompt", "gconfig", "future", "max_tokens",
-                 "tokens_done", "cls", "t_enqueue")
+                 "tokens_done", "cls", "t_enqueue", "t_enqueue_wall",
+                 "trace")
 
     def __init__(self, prompt, gconfig, max_tokens, future, rid=None,
-                 tokens_done=0, cls="rollout"):
+                 tokens_done=0, cls="rollout", trace=None):
         self.rid = rid
         self.prompt = prompt
         self.gconfig = gconfig
@@ -108,6 +109,11 @@ class _Pending:
         self.future = future
         self.cls = cls  # request class (serving.REQUEST_CLASSES)
         self.t_enqueue = time.monotonic()
+        self.t_enqueue_wall = time.time()
+        # Adopted cross-worker trace context (telemetry.TraceContext) —
+        # the server's queue-wait/prefill/decode spans link back to the
+        # client's generate span through it. None for untraced requests.
+        self.trace = trace
 
 
 # Retained decode states moved into the serving engine (KVStateStore);
@@ -267,13 +273,23 @@ class GenerationServer:
                 ])
             S = shapes.round_capacity(padded.shape[1] + chunk)
             shapes.observe("prefill", B_pad, padded.shape[1], S)
+            t_prefill_wall = time.time()
+            t_prefill = time.monotonic()
             st = genmod.prefill_state(
                 params, self.model_cfg, jnp.asarray(padded),
                 jnp.asarray(plens), S,
             )
+            prefill_secs = time.monotonic() - t_prefill
             self._prefill_tokens += int(plens[:len(fresh)].sum())
             for i, p in enumerate(fresh):
                 row_states[id(p)] = genmod.slice_state(st, i)
+                if p.trace is not None:
+                    # Shared batched-prefill window, tagged per request.
+                    self.telemetry.add_span(
+                        "genserver/prefill", t_prefill_wall, prefill_secs,
+                        trace=p.trace, prompt_len=len(p.prompt),
+                        batch_size=len(fresh),
+                    )
         for p, rs in cont:
             row_states[id(p)] = genmod.grow_state(
                 rs.state, shapes.round_capacity(rs.cur_len + chunk)
@@ -476,8 +492,11 @@ class GenerationServer:
             batch += self._queue.drain(cfg.max_batch_size - 1)
             t_formed = time.monotonic()
             for p in batch:
+                # The serving engine owns the SLO observation AND the
+                # per-request trace span for the queue stage.
                 self.serving.record_queue_wait(
-                    p.cls, t_formed - p.t_enqueue
+                    p.cls, t_formed - p.t_enqueue,
+                    trace=p.trace, t_start_wall=p.t_enqueue_wall,
                 )
             try:
                 with self.telemetry.span("genserver/decode_chunk",
@@ -492,8 +511,19 @@ class GenerationServer:
                 self.telemetry.inc("genserver/generated_tokens",
                                    attrs["tokens"])
                 dt = time.monotonic() - t_formed
+                t_decode_wall = time.time() - dt
                 for p, r in zip(batch, results):
                     n_tok = len(r["output_ids"])
+                    if p.trace is not None:
+                        # This request's share of the batched decode
+                        # window (wall window is shared — per-request
+                        # token counts distinguish the rows).
+                        self.telemetry.add_span(
+                            "genserver/decode", t_decode_wall, dt,
+                            trace=p.trace, tokens=n_tok,
+                            batch_size=len(batch),
+                            version=r.get("version"),
+                        )
                     if p.tokens_done == 0:
                         # Time-to-first-chunk: enqueue → first tokens of a
                         # NEW generation (continuations measure per-token).
@@ -542,6 +572,10 @@ class GenerationServer:
             rid=d.get("rid"),
             tokens_done=int(d.get("tokens_done", 0)),
             cls=cls,
+            # Adopt the caller's trace (header absent / telemetry off
+            # → None, zero extra work).
+            trace=(telemetry.extract_headers(request.headers)
+                   if self.telemetry.enabled else None),
         )
         try:
             # Admission + enqueue are one atomic decision on the event

@@ -172,6 +172,15 @@ class GserverManager:
         # says "N consecutive health failures".
         why = (f"; last failure: {st.last_failure}"
                if st.last_failure and st.last_failure not in reason else "")
+        # Leave post-mortem evidence when a fault-tolerance path fires:
+        # the eviction lands in the flight ring as an event, and the
+        # manager's recent span/event window is dumped to
+        # flight_gserver_manager0.jsonl (no-op without flight_dir).
+        self.telemetry.event(
+            "gsmgr/evict", url=url, reason=reason,
+            last_failure=st.last_failure, dropped_leases=len(dropped),
+        )
+        self.telemetry.flight_dump(reason=f"evict {url}: {reason}")
         logger.warning(
             f"evicted {url} ({reason}{why}); dropped {len(dropped)} leases, "
             f"{len(self.servers)} servers remain"
@@ -528,6 +537,17 @@ class GserverManager:
         if self.is_staled():
             return web.json_response({"allowed": False, "reason": "staleness"})
         self.running_rollouts += n
+        # Adopt the caller's sample trace: the gate's ADMIT decision
+        # joins the stitched timeline (denials stay counters only — a
+        # closed gate produces ~2 retries/s per pending prompt and would
+        # flood the span buffers).
+        if self.telemetry.enabled:
+            ctx = telemetry.extract_headers(request.headers)
+            if ctx is not None:
+                self.telemetry.add_span(
+                    "gsmgr/alloc", time.time(), 0.0, trace=ctx,
+                    n_samples=n, version=self.version,
+                )
         return web.json_response({"allowed": True, "version": self.version})
 
     async def handle_finish_rollout(self, request):
@@ -543,6 +563,13 @@ class GserverManager:
             d.get("n_accepted", n if d.get("accepted") else 0)
         )
         self.accepted_rollouts += n_accepted
+        if self.telemetry.enabled:
+            ctx = telemetry.extract_headers(request.headers)
+            if ctx is not None:
+                self.telemetry.add_span(
+                    "gsmgr/finish", time.time(), 0.0, trace=ctx,
+                    n_samples=n, n_accepted=n_accepted,
+                )
         return web.json_response({"ok": True})
 
     async def handle_get_model_version(self, request):

@@ -131,6 +131,10 @@ class MasterWorker:
             self._aggregator = telemetry.TelemetryAggregator(
                 self.cfg.experiment, self.cfg.trial, jsonl_path=jsonl,
                 http_port=self.cfg.telemetry.http_port,
+                # Stitched sample-lineage traces (one line per trained
+                # sample); defaults next to telemetry.jsonl.
+                traces_path=self.cfg.telemetry.traces_path,
+                stitch_grace_secs=self.cfg.telemetry.stitch_grace_secs,
             )
             telemetry.configure(
                 self.cfg.experiment, self.cfg.trial, "master", 0,

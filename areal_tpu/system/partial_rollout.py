@@ -193,8 +193,14 @@ class PartialRolloutClient:
                         self.faults.maybe_fail("generate", url=url,
                                                tokens_done=len(acc_ids))
                     t_chunk = time.monotonic()
-                    async with self.session.post(f"{url}/generate",
-                                                 json=body) as r:
+                    async with self.session.post(
+                        f"{url}/generate", json=body,
+                        # Trace propagation: the generation server adopts
+                        # this context for its queue-wait/prefill/decode
+                        # spans; {} (telemetry off / no active trace)
+                        # leaves the request byte-identical.
+                        headers=telemetry.inject_headers(),
+                    ) as r:
                         if r.status == 429:
                             # Admission backpressure (docs/serving.md):
                             # the server's class queue is full. Honor the
@@ -204,6 +210,11 @@ class PartialRolloutClient:
                             d429 = await r.json()
                             ra = float(d429.get("retry_after", 0.2))
                             telemetry.inc("rollout/admission_backoff")
+                            telemetry.event(
+                                "rollout/backoff_429", url=url,
+                                retry_after=ra,
+                                tokens_done=len(acc_ids),
+                            )
                             await self._release_quiet(route)
                             route = None
                             if throttled >= self.no_server_wait_secs:
@@ -281,6 +292,14 @@ class PartialRolloutClient:
                         ) from e
                     self.n_failovers += 1
                     telemetry.inc("rollout/chunk_failovers")
+                    # Failover replay leaves trace evidence: the stitched
+                    # timeline (and the flight ring) shows exactly when
+                    # the chunk died and how many tokens the replay
+                    # re-prefilled on the replacement server.
+                    telemetry.event(
+                        "rollout/failover", attempt=failures,
+                        tokens_done=len(acc_ids), error=str(e)[:200],
+                    )
                     logger.warning(
                         f"chunk failed ({e}); re-scheduling "
                         f"(attempt {failures}/{self.retry.max_attempts}, "

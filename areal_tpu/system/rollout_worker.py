@@ -146,6 +146,11 @@ class RolloutWorker:
         async with session.post(
             url, json=payload,
             timeout=aiohttp.ClientTimeout(total=timeout_secs),
+            # Trace propagation (docs/observability.md): the active
+            # sample trace rides /allocate_rollout and /finish_rollout;
+            # empty dict (telemetry off / no trace) leaves the request
+            # byte-identical.
+            headers=telemetry.inject_headers(),
         ) as r:
             return await r.json()
 
@@ -335,10 +340,19 @@ class RolloutWorker:
 
             async def one(rec, uid):
                 async with sem:
-                    with telemetry.span("rollout/rollout", uid=uid) as attrs:
+                    # Sample-lineage trace ORIGIN: one trace per admitted
+                    # prompt, carried (contextvars) through the quota RPC,
+                    # every /generate chunk, the push to the trainer, and
+                    # terminated by the trainer's train_sample span.
+                    with telemetry.start_trace() as tctx, \
+                            telemetry.span("rollout/rollout",
+                                           uid=uid) as attrs:
+                        if tctx is not None:
+                            attrs["trace_id"] = tctx.trace_id
                         # A denied allocation (staleness/capacity gate) must
                         # not drop the prompt — retry until the gate opens.
                         t0 = time.monotonic()
+                        t0_wall = time.time()
                         while True:
                             t_attempt = time.monotonic()
                             status = await self._rollout_one(
@@ -350,6 +364,13 @@ class RolloutWorker:
                         # manager blips) before the successful attempt.
                         telemetry.observe("rollout/alloc_wait_secs",
                                           t_attempt - t0)
+                        # Same window as a trace-stage span so stitched
+                        # timelines show where the gate held this sample.
+                        if tctx is not None:
+                            telemetry.add_span(
+                                "rollout/gate", t0_wall, t_attempt - t0,
+                                trace=tctx, uid=uid,
+                            )
                         attrs["status"] = status
                     if status == "ok":
                         self.consumed.add(uid)

@@ -787,8 +787,17 @@ class ServingEngine:
 
     # ---- SLO recording ----
 
-    def record_queue_wait(self, cls: str, secs: float) -> None:
+    def record_queue_wait(self, cls: str, secs: float,
+                          trace=None, t_start_wall: float = 0.0) -> None:
         self.telemetry.observe(f"serving/{cls}/queue_wait_secs", secs)
+        if trace is not None:
+            # Sample-lineage tracing (docs/observability.md): the same
+            # dwell as a per-request span under the caller's trace — the
+            # "queue" stage of the stitched staleness decomposition.
+            self.telemetry.add_span(
+                "genserver/queue_wait", t_start_wall, secs,
+                trace=trace, cls=cls,
+            )
 
     def record_first_chunk(self, cls: str, secs: float) -> None:
         self.telemetry.observe(f"serving/{cls}/ttfc_secs", secs)

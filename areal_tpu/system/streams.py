@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import pickle
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence
@@ -25,7 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 import zmq
 
-from areal_tpu.base import logging, name_resolve, network
+from areal_tpu.base import logging, name_resolve, network, telemetry
 
 logger = logging.getLogger("system.streams")
 
@@ -54,13 +55,26 @@ class Payload:
 
 
 class MasterRequestStream:
-    """Master-side: one DEALER per handler, addresses from name_resolve."""
+    """Master-side: one DEALER per handler, addresses from name_resolve.
+
+    Thread-safety: the master's asyncio loop runs ``call``/``gather`` from
+    several ``asyncio.to_thread`` workers at once (the data-loading task
+    and every concurrent MFC task share this stream), but ZMQ sockets are
+    not thread-safe. All socket I/O therefore goes through ``_io_lock``
+    with NON-blocking recvs: without it, two threads can both wake from
+    ``poll()`` for the same reply, the loser blocks in ``recv()`` forever
+    while the winner files its reply in ``_pending`` — a whole-step wedge
+    (the long-standing "fabric test hang", finally pinned down by the
+    stitched sample-lineage traces: the trainer's mfc span closed, the
+    master's exec span never did). The lock is held only across a bounded
+    poll+drain (≤ the poll timeout), never across a gather wait."""
 
     def __init__(self, experiment: str, trial: str, handlers: Sequence[str],
                  timeout: float = 300.0):
         self._ctx = zmq.Context.instance()
         self._socks: Dict[str, zmq.Socket] = {}
         self._pending: Dict[str, Payload] = {}
+        self._io_lock = threading.Lock()
         for h in handlers:
             addr = name_resolve.wait(
                 req_reply_addr_key(experiment, trial, h), timeout=timeout
@@ -73,14 +87,23 @@ class MasterRequestStream:
             self._poller.register(s, zmq.POLLIN)
 
     def post(self, p: Payload) -> str:
-        self._socks[p.handler].send(pickle.dumps(p))
-        self._pending[p.request_id] = p
+        raw = pickle.dumps(p)
+        with self._io_lock:
+            self._socks[p.handler].send(raw)
+            self._pending[p.request_id] = p
         return p.request_id
 
     def _drain(self, timeout_ms: int) -> None:
-        for sock, _ in self._poller.poll(timeout_ms):
-            reply: Payload = pickle.loads(sock.recv())
-            self._pending[reply.request_id] = reply
+        with self._io_lock:
+            for sock, _ in self._poller.poll(timeout_ms):
+                try:
+                    # Non-blocking even under the lock: poll() readiness
+                    # is advisory, and a blocking recv on a spurious
+                    # wakeup would hold the lock indefinitely.
+                    reply: Payload = pickle.loads(sock.recv(zmq.NOBLOCK))
+                except zmq.Again:
+                    continue
+                self._pending[reply.request_id] = reply
 
     def gather(self, request_ids: Sequence[str],
                timeout: float = 3600.0) -> List[Payload]:
@@ -221,7 +244,14 @@ class ZmqPusher:
         self._sock.connect(addr)
 
     def push(self, obj: Any) -> None:
-        self._sock.send(_pack(obj))
+        # Sample-lineage tracing (docs/observability.md): dict payloads
+        # pushed while a trace is active gain an OPTIONAL ``_trace`` key
+        # ({trace_id, parent_span}) the puller side may pop — the
+        # trainer re-attaches it to the sample's metadata so the trace
+        # survives buffer/store hops. With telemetry disabled (or no
+        # active trace) inject_payload returns the object untouched:
+        # the wire bytes are identical to the pre-tracing format.
+        self._sock.send(_pack(telemetry.inject_payload(obj)))
 
     def close(self):
         self._sock.close(linger=0)

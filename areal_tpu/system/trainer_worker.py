@@ -232,7 +232,16 @@ class TrainerWorker:
         while not self._exiting:
             obj = self._puller.pull(timeout_ms=200)
             if obj is not None:
-                self._pull_q.put(SequenceSample.from_json_compatible(obj))
+                # Optional sample-lineage context pushed by the rollout
+                # worker (streams.ZmqPusher): keep it in the sample's
+                # METADATA — it survives the master's metadata buffer and
+                # this store untouched, so the train step can close the
+                # trace with a terminal span (docs/observability.md).
+                trace = telemetry.extract_payload(obj)
+                s = SequenceSample.from_json_compatible(obj)
+                if trace is not None:
+                    s.metadata["_trace"] = [trace.as_dict()]
+                self._pull_q.put(s)
 
     # ---------------- handlers ----------------
 
@@ -319,6 +328,8 @@ class TrainerWorker:
         for hook in p.pre_hooks:
             self._run_hook(hook)
         trace_dir = os.environ.get("AREAL_DUMP_TRACE")
+        t_mfc_wall = time.time()
+        t_mfc = time.monotonic()
         with telemetry.span("trainer/mfc", mfc=mfc_name, method=method,
                             n_seqs=batch.bs):
             if trace_dir:
@@ -337,6 +348,9 @@ class TrainerWorker:
         result: Dict[str, Any] = {"stats": None, "meta": None}
         if method == "train_step":
             result["stats"] = out
+            self._emit_terminal_spans(
+                req["ids"], model, t_mfc_wall, time.monotonic() - t_mfc
+            )
         elif out is not None:
             remap = req.get("output_remap") or {}
             if remap:
@@ -355,6 +369,36 @@ class TrainerWorker:
         for hook in p.post_hooks:
             self._run_hook(hook)
         return result
+
+    def _emit_terminal_spans(self, ids, model, t_start: float,
+                             dur_secs: float) -> None:
+        """Close each traced sample's lineage: a terminal
+        ``trainer/train_sample`` span recording WHICH weight version
+        trained it — the stitcher (base/telemetry.TraceStitcher) keys the
+        prompt→trained latency + stage breakdown off this span. The trace
+        is CONSUMED from the stored sample's metadata on emit: several
+        TRAIN_STEP MFCs may read the same sample ids in one step
+        (actor_train + critic_train), and only the first to train it
+        terminates the trace — otherwise every stitched metric would
+        double per extra train MFC. No-op with telemetry disabled or for
+        untraced samples."""
+        if not telemetry.enabled():
+            return
+        version = model.version.global_step
+        for sid in ids:
+            s = self.store.get(sid)
+            if s is None:
+                continue
+            tr = (s.metadata.pop("_trace", None) or [None])[0]
+            if not isinstance(tr, dict):
+                continue
+            ctx = telemetry.TraceContext.from_dict(tr)
+            if ctx is None:
+                continue
+            telemetry.add_span(
+                "trainer/train_sample", t_start, dur_secs, trace=ctx,
+                sample_id=str(sid), weight_version=version,
+            )
 
     def _run_hook(self, hook: Dict) -> None:
         kind = hook.get("kind")

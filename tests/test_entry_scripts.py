@@ -47,6 +47,13 @@ def _run_entry(script, tmp_path, extra, timeout=420):
     )
 
 
+# The two full-experiment launch tests are the heaviest e2e variants in
+# the suite (each spawns a complete experiment as a subprocess; the sync
+# one exceeds its own 420 s cap on a loaded CI box) and duplicate the
+# in-process coverage of test_system_{sync,async}_ppo through the CLI
+# layer — tier-1 keeps the cheap CLI checks below, the launches run in
+# the full (slow-inclusive) suite.
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_main_sync_ppo_launches(tmp_path):
     r = _run_entry("main_sync_ppo.py", tmp_path, [])
@@ -58,6 +65,7 @@ def test_main_sync_ppo_launches(tmp_path):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(600)
 def test_main_async_ppo_launches(tmp_path):
     r = _run_entry("main_async_ppo.py", tmp_path, [

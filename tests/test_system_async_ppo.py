@@ -28,8 +28,12 @@ EXP, TRIAL = "asyncppo", "t0"
 TINY = {"vocab_size": 258, "seed": 0}
 # Telemetry rides along on the full-loop e2e (docs/observability.md):
 # every worker kind pushes snapshots to the master's aggregator. Fast
-# flushes so the few-step run lands several snapshots per worker.
-TEL = {"enabled": True, "flush_interval_secs": 0.3}
+# flushes so the few-step run lands several snapshots per worker, and a
+# proportionally short stitch grace so traces appear on the LIVE merged
+# scrape before the short run ends (tiny models can finish all three
+# steps inside the default 5 s grace).
+TEL = {"enabled": True, "flush_interval_secs": 0.3,
+       "stitch_grace_secs": 0.8}
 
 
 def _tel():
@@ -46,7 +50,7 @@ def _serving():
     return ServingConfig(enabled=True)
 
 
-def _gen_fleet_main(nr_root, data_path, realloc_dir):
+def _gen_fleet_main(nr_root, data_path, realloc_dir, flight_dir):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -54,6 +58,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
 
     nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
     import asyncio
+    import dataclasses as dc
 
     from areal_tpu.api.model import GenerationHyperparameters
     from areal_tpu.models import transformer
@@ -68,6 +73,10 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
     )
     from areal_tpu.system.rollout_worker import RolloutWorker, RolloutWorkerConfig
 
+    # Flight recorder armed (docs/observability.md): killing this process
+    # mid-run must leave flight_<worker>.jsonl evidence behind.
+    tel = dc.replace(_tel(), flight_dir=flight_dir)
+
     async def main():
         kw = dict(TINY)
         seed = kw.pop("seed", 0)
@@ -76,7 +85,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
         server = GenerationServer(
             GenerationServerConfig(
                 experiment=EXP, trial=TRIAL, chunk_tokens=4,
-                prompt_bucket=16, batch_window_ms=2, telemetry=_tel(),
+                prompt_bucket=16, batch_window_ms=2, telemetry=tel,
                 serving=_serving(),
             ),
             cfg, params,
@@ -85,7 +94,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
         mgr = GserverManager(GserverManagerConfig(
             experiment=EXP, trial=TRIAL, n_servers=1, train_batch_size=4,
             max_head_offpolicyness=4, realloc_dir=realloc_dir,
-            weight_poll_secs=0.2, telemetry=_tel(),
+            weight_poll_secs=0.2, telemetry=tel,
         ))
         await mgr.start()
         worker = RolloutWorker(RolloutWorkerConfig(
@@ -93,7 +102,7 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir):
             gconfig=GenerationHyperparameters(max_new_tokens=8),
             group_size=2, chunk_tokens=4, max_concurrent=4,
             tokenizer=MockTokenizer(), max_rollouts=None,
-            telemetry=_tel(),
+            telemetry=tel,
         ))
         await worker.run_async()  # runs until killed
 
@@ -197,6 +206,7 @@ def test_async_ppo_full_loop(tmp_path):
     data_path = str(tmp_path / "math.jsonl")
     realloc_dir = str(tmp_path / "realloc")
     jsonl_path = str(tmp_path / "telemetry.jsonl")
+    flight_dir = str(tmp_path / "flight")
     make_math_jsonl(data_path, n=8)
     name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_root)
 
@@ -204,7 +214,8 @@ def test_async_ppo_full_loop(tmp_path):
     trainer = ctx.Process(target=_trainer_main,
                           args=(nr_root, realloc_dir), daemon=True)
     fleet = ctx.Process(target=_gen_fleet_main,
-                        args=(nr_root, data_path, realloc_dir), daemon=True)
+                        args=(nr_root, data_path, realloc_dir, flight_dir),
+                        daemon=True)
     trainer.start()
     fleet.start()
 
@@ -263,6 +274,35 @@ def test_async_ppo_full_loop(tmp_path):
 
     probe = threading.Thread(target=_interactive_probe, daemon=True)
     probe.start()
+
+    # The aggregator's merged fleet endpoint closes with the master, so
+    # the "real Prometheus scrape carries the stitched prompt→trained
+    # histogram" assertion polls it WHILE the run executes and keeps the
+    # first body where the derived trace metrics went nonzero.
+    from areal_tpu.base import network
+
+    agg_port = network.find_free_port()
+    merged_scrape = []
+
+    def _merged_scrape_probe():
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not merged_scrape:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{agg_port}/metrics", timeout=5
+                ) as r:
+                    body = r.read().decode()
+                for ln in body.splitlines():
+                    if (ln.startswith("areal_trace_e2e_secs_count")
+                            and float(ln.rpartition(" ")[2]) > 0):
+                        merged_scrape.append(body)
+                        return
+            except Exception:  # noqa: BLE001 — aggregator not up yet
+                pass
+            time.sleep(0.3)
+
+    scraper = threading.Thread(target=_merged_scrape_probe, daemon=True)
+    scraper.start()
     try:
         from areal_tpu.system.master_worker import (
             ExperimentSaveEvalControl,
@@ -278,7 +318,8 @@ def test_async_ppo_full_loop(tmp_path):
                 exp_ctrl=ExperimentSaveEvalControl(
                     total_train_epochs=10**6, benchmark_steps=3,
                 ),
-                telemetry=dc.replace(_tel(), jsonl_path=jsonl_path),
+                telemetry=dc.replace(_tel(), jsonl_path=jsonl_path,
+                                     http_port=agg_port),
             ),
             _build_async_dfg(),
         )
@@ -333,6 +374,52 @@ def test_async_ppo_full_loop(tmp_path):
         assert "areal_genserver_kv_states" in prom
         # the manager routed a class-aware interactive lease
         assert "areal_gsmgr_scheduled_interactive_total" in mprom
+        # --- sample-lineage tracing landed (docs/observability.md) ---
+        # traces.jsonl (default: next to telemetry.jsonl) holds stitched
+        # end-to-end timelines whose spans come from ≥3 worker kinds:
+        # the rollout worker that originated the trace, the generation
+        # server that decoded it, and the trainer's terminal span.
+        import os
+
+        traces_path = str(tmp_path / "traces.jsonl")
+        assert os.path.exists(traces_path), os.listdir(tmp_path)
+        with open(traces_path) as f:
+            traces = [_json.loads(ln) for ln in f if ln.strip()]
+        assert traces
+        kinds_per_trace = [
+            {w.split(":")[0] for w in t["workers"]} for t in traces
+        ]
+        assert any(
+            {"rollout", "generation_server", "trainer"} <= ks
+            for ks in kinds_per_trace
+        ), kinds_per_trace
+        full = next(t for t, ks in zip(traces, kinds_per_trace)
+                    if {"rollout", "generation_server", "trainer"} <= ks)
+        assert full["e2e_secs"] > 0 and full["weight_version"] >= 0
+        names_in_trace = {s["name"] for s in full["spans"]}
+        assert "rollout/generate" in names_in_trace
+        assert "genserver/queue_wait" in names_in_trace
+        assert "trainer/train_sample" in names_in_trace
+        assert set(full["stages"]) == {"generate", "queue", "gate",
+                                       "train_wait", "train"}
+        # the REAL merged Prometheus scrape (captured live) carries the
+        # prompt→trained latency histogram with nonzero counts
+        scraper.join(timeout=60)
+        assert merged_scrape, "merged /metrics never showed trace metrics"
+        assert "# TYPE areal_trace_e2e_secs histogram" in merged_scrape[0]
+        assert "areal_trace_stage_train_wait_secs_bucket" in merged_scrape[0]
+        # --- flight recorder: killing a generation server mid-run leaves
+        # crash evidence (SIGTERM hook dumps each worker's ring) ---
+        assert fleet.is_alive()
+        fleet.terminate()
+        fleet.join(timeout=15)
+        flight_files = sorted(os.listdir(str(tmp_path / "flight")))
+        assert any(fn.startswith("flight_generation_server")
+                   for fn in flight_files), flight_files
+        with open(tmp_path / "flight" / flight_files[0]) as f:
+            frecs = [_json.loads(ln) for ln in f if ln.strip()]
+        assert frecs and frecs[-1]["kind"] == "dump"
+        assert frecs[-1]["reason"] == "sigterm"
     finally:
         for p in (trainer, fleet):
             if p.is_alive():

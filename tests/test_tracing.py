@@ -1,0 +1,500 @@
+"""Sample-lineage tracing + flight recorder (base/telemetry.py,
+docs/observability.md).
+
+All in-process fakes, zero real sleeps: traces are injected/extracted
+through the real helpers, the stitcher is fed directly, flight triggers
+are polled explicitly, and the disabled path is asserted byte-identical.
+"""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.base import name_resolve, names, telemetry
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture()
+def enabled_telemetry(tmp_name_resolve):
+    """Process-global telemetry on (no flushing thread activity: huge
+    interval), reset afterwards."""
+    sink = telemetry.configure(
+        "tr", "t0", "rollout", 0,
+        TelemetryConfig(enabled=True, flush_interval_secs=3600),
+    )
+    yield sink
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# context propagation: headers + payload dicts
+# ---------------------------------------------------------------------------
+
+
+def test_header_roundtrip(enabled_telemetry):
+    with telemetry.start_trace() as ctx:
+        assert ctx is not None and len(ctx.trace_id) == 16
+        h = telemetry.inject_headers()
+        assert set(h) == {telemetry.TRACE_HEADER}
+        got = telemetry.extract_headers(h)
+        assert got.trace_id == ctx.trace_id
+        # no open span: the original (absent) parent rides along
+        assert got.parent_span is None
+        with telemetry.span("rollout/generate"):
+            h2 = telemetry.inject_headers()
+        got2 = telemetry.extract_headers(h2)
+        # parent is the GLOBAL span ref of the open span: worker/<id>
+        assert got2.parent_span.startswith("rollout:0/")
+    # outside the trace: nothing to inject
+    assert telemetry.inject_headers() == {}
+    assert telemetry.extract_headers({}) is None
+    assert telemetry.extract_headers({telemetry.TRACE_HEADER: ""}) is None
+
+
+def test_payload_roundtrip(enabled_telemetry):
+    with telemetry.start_trace() as ctx:
+        d = telemetry.inject_payload({"ids": ["a"]})
+        assert d[telemetry.TRACE_FIELD]["trace_id"] == ctx.trace_id
+    got = telemetry.extract_payload(d)
+    assert got.trace_id == ctx.trace_id
+    assert telemetry.TRACE_FIELD not in d  # popped: sample parses clean
+    assert telemetry.extract_payload({"ids": ["a"]}) is None
+    assert telemetry.extract_payload(None) is None
+
+
+def test_disabled_is_byte_identical(tmp_name_resolve):
+    """The acceptance contract: telemetry off ⇒ wire payloads and request
+    headers are exactly what a tracing-free build would produce."""
+    from areal_tpu.system.streams import _pack
+
+    telemetry.shutdown()
+    assert telemetry.inject_headers() == {}
+    obj = {"ids": ["q1@0"], "seqlens": [4]}
+    ref_bytes = _pack({"ids": ["q1@0"], "seqlens": [4]})
+    out = telemetry.inject_payload(obj)
+    assert out is obj and telemetry.TRACE_FIELD not in obj
+    assert _pack(obj) == ref_bytes
+    # start_trace with telemetry disabled allocates nothing
+    with telemetry.start_trace() as ctx:
+        assert ctx is None
+        assert telemetry.inject_headers() == {}
+        assert _pack(telemetry.inject_payload(obj)) == ref_bytes
+
+
+def test_span_adopts_trace_and_remote_parent():
+    r = telemetry.TelemetryRegistry()
+    ctx = telemetry.TraceContext("t" * 16, parent_span="rollout:0/7")
+    with telemetry.trace_scope(ctx):
+        with r.span("genserver/decode_chunk"):
+            with r.span("inner"):
+                pass
+    spans = {s["name"]: s for s in r.snapshot()["spans"]}
+    root = spans["genserver/decode_chunk"]
+    assert root["trace_id"] == "t" * 16
+    # local root of the distributed trace links to the REMOTE parent
+    assert root["remote_parent"] == "rollout:0/7"
+    inner = spans["inner"]
+    assert inner["trace_id"] == "t" * 16
+    assert inner["parent_id"] == root["span_id"]
+    assert "remote_parent" not in inner  # has a local parent instead
+    # untraced spans keep the wire format unchanged
+    with r.span("plain"):
+        pass
+    (plain,) = r.snapshot()["spans"]
+    assert "trace_id" not in plain and "remote_parent" not in plain
+
+
+def test_add_span_and_event():
+    r = telemetry.TelemetryRegistry()
+    ctx = telemetry.TraceContext("abc", parent_span="rollout:1/3")
+    sid = r.add_span("genserver/queue_wait", 100.0, 0.25, trace=ctx, cls="x")
+    with telemetry.trace_scope(ctx):
+        with r.span("rollout/generate"):
+            r.event("rollout/failover", attempt=2)
+    spans = {s["name"]: s for s in r.snapshot()["spans"]}
+    qw = spans["genserver/queue_wait"]
+    assert qw["span_id"] == sid and qw["t_start"] == 100.0
+    assert qw["dur_secs"] == 0.25 and qw["trace_id"] == "abc"
+    assert qw["remote_parent"] == "rollout:1/3"
+    ev = spans["rollout/failover"]
+    assert ev["dur_secs"] == 0.0 and ev["trace_id"] == "abc"
+    assert ev["parent_id"] == spans["rollout/generate"]["span_id"]
+    # manual spans feed the duration histograms like context-manager spans
+    assert r.snapshot()["hists"]["genserver/queue_wait/secs"]["count"] == 1
+
+
+def test_spans_dropped_is_a_first_class_counter():
+    r = telemetry.TelemetryRegistry(max_spans=3)
+    for i in range(8):
+        with r.span(f"s{i}"):
+            pass
+    s = r.snapshot()
+    assert s["dropped_spans"] == 5
+    assert s["counters"]["telemetry/spans_dropped"] == 5.0
+    text = telemetry.render_prometheus(s)
+    assert "# TYPE areal_telemetry_spans_dropped_total counter" in text
+    assert "areal_telemetry_spans_dropped_total 5" in text
+
+
+# ---------------------------------------------------------------------------
+# Prometheus label escaping (exposition-format edge cases)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_label_escaping():
+    text = telemetry.render_prometheus(
+        {"gauges": {"g": 1.0}},
+        labels={"why": 'quote " back \\ slash', "nl": "line1\nline2"},
+    )
+    # exactly one sample line, with \" , \\ and \n all escaped
+    sample = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert len(sample) == 1
+    assert '\n' not in sample[0]  # the newline never splits the line
+    assert 'nl="line1\\nline2"' in sample[0]
+    assert 'why="quote \\" back \\\\ slash"' in sample[0]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, dump, on-demand trigger, crash hook
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_dump(tmp_path):
+    fr = telemetry.FlightRecorder(maxlen=4)
+    r = telemetry.TelemetryRegistry()
+    r.flight = fr
+    for i in range(9):
+        with r.span(f"s{i}"):
+            pass
+    recs = fr.snapshot()
+    assert [x["name"] for x in recs] == ["s5", "s6", "s7", "s8"]
+    path = str(tmp_path / "sub" / "flight_rollout0.jsonl")
+    n = fr.dump(path, reason="unit")
+    assert n == 4
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert [x["name"] for x in lines[:-1]] == ["s5", "s6", "s7", "s8"]
+    assert lines[-1]["kind"] == "dump" and lines[-1]["reason"] == "unit"
+    assert lines[-1]["n_records"] == 4
+
+
+def test_flight_trigger_fans_out_once_per_nonce(tmp_name_resolve, tmp_path):
+    reg = telemetry.TelemetryRegistry()
+    reg.flight = telemetry.FlightRecorder()
+    with reg.span("before_crash"):
+        pass
+    p = telemetry.TelemetryPusher(reg, "fl", "t", "generation_server", 2,
+                                  flush_interval_secs=3600)
+    try:
+        assert p.check_flight_trigger() is None  # no trigger pending
+        out = str(tmp_path / "dumps")
+        telemetry.request_flight_dump("fl", "t", out)
+        path = p.check_flight_trigger()
+        assert path == os.path.join(out, "flight_generation_server2.jsonl")
+        assert os.path.exists(path)
+        # same nonce again: no re-dump (the flag is NOT consumed — other
+        # workers still need to see it — but this worker acted once)
+        assert p.check_flight_trigger() is None
+        # a NEW trigger fires again
+        telemetry.request_flight_dump("fl", "t", out)
+        assert p.check_flight_trigger() == path
+    finally:
+        p.close()
+
+
+def test_telemetry_instance_flight_dump(tmp_name_resolve, tmp_path):
+    cfg = TelemetryConfig(enabled=True, flush_interval_secs=3600,
+                          flight_recorder_len=16,
+                          flight_dir=str(tmp_path / "fl"))
+    t = telemetry.Telemetry("fd", "t", "gserver_manager", 0, cfg=cfg,
+                            push=False)
+    try:
+        t.event("gsmgr/evict", url="http://dead:1", reason="test")
+        path = t.flight_dump(reason="evict")
+        assert path.endswith("flight_gserver_manager0.jsonl")
+        with open(path) as f:
+            recs = [json.loads(ln) for ln in f]
+        assert recs[0]["name"] == "gsmgr/evict"
+        assert recs[-1]["reason"] == "evict"
+        # the crash path dumps every live instance
+        assert path in telemetry._dump_all_flight("unit")
+    finally:
+        t.close()
+
+
+def test_null_sink_flight_api(tmp_name_resolve):
+    telemetry.shutdown()
+    sink = telemetry.get()
+    assert sink.flight_dump() is None
+    sink.event("x")  # no-op, no raise
+    assert sink.add_span("x", 0.0, 0.0) == 0
+
+
+# ---------------------------------------------------------------------------
+# trace stitching (master side)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, t0, dur, trace_id, **attrs):
+    return {"name": name, "span_id": attrs.pop("span_id", 1),
+            "parent_id": None, "t_start": t0, "dur_secs": dur,
+            "attrs": attrs, "trace_id": trace_id}
+
+
+def test_stitcher_joins_workers_and_derives_stages(tmp_path):
+    traces = str(tmp_path / "traces.jsonl")
+    st = telemetry.TraceStitcher(traces, grace_secs=0.0)
+    tid = "f" * 16
+    # rollout: gate 1s then generate 2s inside a 3s rollout
+    st.feed("rollout:0", [
+        _span("rollout/gate", 100.0, 1.0, tid, span_id=1),
+        _span("rollout/generate", 101.0, 2.0, tid, span_id=2),
+        _span("rollout/rollout", 100.0, 3.2, tid, span_id=3),
+    ])
+    # generation server: two chunks' queue waits
+    st.feed("generation_server:0", [
+        _span("genserver/queue_wait", 101.1, 0.3, tid, span_id=4),
+        _span("genserver/queue_wait", 102.0, 0.2, tid, span_id=5),
+        _span("genserver/decode", 102.2, 0.5, tid, span_id=6),
+    ])
+    assert st.registry.snapshot()["counters"].get("trace/stitched") is None
+    # trainer: terminal span 5s after the rollout finished
+    st.feed("trainer:0", [
+        _span("trainer/train_sample", 108.5, 0.7, tid, span_id=7,
+              sample_id="q1@0", weight_version=4),
+    ])
+    snap = st.registry.snapshot()
+    assert snap["counters"]["trace/stitched"] == 1.0
+    with open(traces) as f:
+        (rec,) = [json.loads(ln) for ln in f]
+    assert rec["trace_id"] == tid
+    assert rec["sample_id"] == "q1@0" and rec["weight_version"] == 4
+    assert set(rec["workers"]) == {"rollout:0", "generation_server:0",
+                                   "trainer:0"}
+    assert abs(rec["e2e_secs"] - (108.5 + 0.7 - 100.0)) < 1e-6
+    stages = rec["stages"]
+    assert abs(stages["gate"] - 1.0) < 1e-6
+    assert abs(stages["generate"] - 2.0) < 1e-6
+    assert abs(stages["queue"] - 0.5) < 1e-6  # both chunk waits summed
+    assert abs(stages["train"] - 0.7) < 1e-6
+    # train_wait = terminal start − rollout end = 108.5 − 103.2
+    assert abs(stages["train_wait"] - 5.3) < 1e-6
+    # derived first-class metrics: e2e + per-stage histograms
+    hists = snap["hists"]
+    assert hists["trace/e2e_secs"]["count"] == 1
+    for k in telemetry.TRACE_STAGES:
+        assert hists[f"trace/stage_{k}_secs"]["count"] == 1
+    # untraced spans never buffer
+    st.feed("rollout:0", [{"name": "x", "span_id": 9, "parent_id": None,
+                           "t_start": 0.0, "dur_secs": 0.1, "attrs": {}}])
+    assert len(st._traces) == 1
+    st.close()
+
+
+def test_stitcher_bounds_unfinished_traces(tmp_path):
+    st = telemetry.TraceStitcher(None, max_traces=3)
+    for i in range(6):
+        st.feed("rollout:0", [_span("rollout/generate", float(i), 0.1,
+                                    f"trace{i:02d}")])
+    assert len(st._traces) == 3
+    assert st.registry.snapshot()["counters"][
+        "trace/unstitched_evicted"] == 3.0
+
+
+def test_stitcher_group_terminals_count_once_and_stitched_age_silently():
+    """A group's samples share ONE trace: k terminal spans observe the
+    per-sample histograms k times but count ONE completed trace, each
+    with its OWN train stage (not the sum); completed traces aging out
+    of the LRU are normal turnover, not a loss signal."""
+    st = telemetry.TraceStitcher(None, max_traces=2, grace_secs=0.0)
+    tid = "g" * 16
+    st.feed("rollout:0", [_span("rollout/rollout", 100.0, 2.0, tid,
+                                span_id=1)])
+    st.feed("trainer:0", [
+        _span("trainer/train_sample", 105.0, 0.5, tid, span_id=2,
+              sample_id="q1@0", weight_version=2),
+        _span("trainer/train_sample", 109.0, 0.25, tid, span_id=3,
+              sample_id="q1@1", weight_version=3),
+    ])
+    snap = st.registry.snapshot()
+    assert snap["counters"]["trace/stitched"] == 1.0  # unique traces
+    assert snap["hists"]["trace/e2e_secs"]["count"] == 2  # per sample
+    # train stage is each terminal's own duration, never the group sum
+    assert abs(snap["hists"]["trace/stage_train_secs"]["sum"]
+               - (0.5 + 0.25)) < 1e-9
+    # a STITCHED trace falling off the LRU is not "unstitched_evicted"
+    st.feed("rollout:0", [_span("rollout/generate", 0.0, 0.1, "other1" * 3),
+                          _span("rollout/generate", 0.0, 0.1, "other2" * 3)])
+    c = st.registry.snapshot()["counters"]
+    assert "trace/unstitched_evicted" not in c
+
+
+def test_stitcher_eviction_spares_traces_awaiting_their_grace():
+    """A trace whose terminal already arrived but is still inside the
+    stitch grace window must survive LRU pressure — evicting it would
+    silently drop a COMPLETED trace and miscount it as unstitched."""
+    st = telemetry.TraceStitcher(None, max_traces=2, grace_secs=3600.0)
+    done = "done" * 4
+    st.feed("trainer:0", [_span("trainer/train_sample", 1.0, 0.1, done,
+                                sample_id="s", weight_version=1)])
+    # flood with fresh traces: `done` is the LRU victim candidate
+    for i in range(4):
+        st.feed("rollout:0", [_span("rollout/generate", float(i), 0.1,
+                                    f"fresh{i:03d}" * 2)])
+    assert done in st._traces  # kept despite the LRU bound
+    st.tick(force=True)
+    snap = st.registry.snapshot()
+    assert snap["counters"]["trace/stitched"] == 1.0
+    # the flooded-out traces without terminals are the real losses
+    assert snap["counters"]["trace/unstitched_evicted"] >= 2.0
+
+
+def test_stitcher_grace_defers_until_tick():
+    """Terminal spans wait out the sibling workers' flush skew before
+    stitching; close()/tick(force=True) never drops stragglers."""
+    st = telemetry.TraceStitcher(None, grace_secs=3600.0)
+    tid = "d" * 16
+    st.feed("trainer:0", [_span("trainer/train_sample", 10.0, 0.1, tid,
+                                sample_id="s", weight_version=1)])
+    assert "trace/stitched" not in st.registry.snapshot()["counters"]
+    # the rollout spans arrive late (slower flush cadence) — and are
+    # still part of the stitched record thanks to the grace window
+    st.feed("rollout:0", [_span("rollout/rollout", 5.0, 2.0, tid)])
+    st.tick()  # grace not elapsed: still deferred
+    assert "trace/stitched" not in st.registry.snapshot()["counters"]
+    st.tick(force=True)
+    snap = st.registry.snapshot()
+    assert snap["counters"]["trace/stitched"] == 1.0
+    # e2e measured from the LATE-arriving rollout root, not the terminal
+    (e2e,) = [snap["hists"]["trace/e2e_secs"]["sum"]]
+    assert abs(e2e - (10.0 + 0.1 - 5.0)) < 1e-9
+
+
+def test_aggregator_exports_stitched_metrics(tmp_name_resolve, tmp_path):
+    jsonl = str(tmp_path / "telemetry.jsonl")
+    agg = telemetry.TelemetryAggregator("st", "t", jsonl_path=jsonl)
+    try:
+        # traces.jsonl defaults NEXT TO telemetry.jsonl
+        assert agg.traces_path == str(tmp_path / "traces.jsonl")
+        tid = "a" * 16
+        agg.stitcher.feed("rollout:0",
+                          [_span("rollout/generate", 10.0, 1.0, tid)])
+        agg.stitcher.feed("trainer:0",
+                          [_span("trainer/train_sample", 12.0, 0.5, tid,
+                                 sample_id="s", weight_version=1)])
+        agg.stitcher.tick(force=True)  # skip the flush-skew grace window
+        text = agg.render_prometheus()
+        assert "# TYPE areal_trace_e2e_secs histogram" in text
+        assert 'areal_trace_e2e_secs_count{worker_index="0",' \
+               'worker_kind="aggregator"} 1' in text
+        assert "areal_trace_stage_generate_secs_bucket" in text
+        assert 'areal_trace_stitched_total{worker_index="0",' \
+               'worker_kind="aggregator"} 1' in text
+        assert os.path.exists(agg.traces_path)
+    finally:
+        agg.close()
+
+
+# ---------------------------------------------------------------------------
+# /metrics (Prometheus) vs /metrics.json parity (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _prom_gauges(text, prefix):
+    out = {}
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln.startswith(prefix):
+            continue
+        name, _, val = ln.rpartition(" ")
+        base = name.partition("{")[0]
+        out[base] = float(val)
+    return out
+
+
+def test_gsmgr_metrics_parity(tmp_name_resolve):
+    import asyncio
+
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+    )
+
+    from areal_tpu.system.gserver_manager import _ServerHealth
+
+    mgr = GserverManager(GserverManagerConfig())
+    mgr.servers = ["http://a:1", "http://b:2"]
+    mgr.health = {u: _ServerHealth() for u in mgr.servers}
+    mgr.version = 7
+    mgr.running_rollouts = 5
+    mgr.accepted_rollouts = 11
+    mgr._inflight = {"http://a:1": 2, "http://b:2": 1}
+    mgr.last_sync_fanout_secs = 1.5
+
+    async def both():
+        prom = await mgr.handle_metrics(None)
+        js = await mgr.handle_metrics_json(None)
+        return prom.text, json.loads(js.text)
+
+    prom_text, js = asyncio.run(both())
+    g = _prom_gauges(prom_text, "areal_gsmgr_")
+    assert g["areal_gsmgr_weight_version"] == js["version"] == 7
+    assert g["areal_gsmgr_running_rollouts"] == js["running_rollouts"] == 5
+    assert (g["areal_gsmgr_accepted_rollouts"]
+            == js["accepted_rollouts"] == 11)
+    assert g["areal_gsmgr_healthy_servers"] == js["healthy_servers"] == 2
+    assert g["areal_gsmgr_known_servers"] == js["known_servers"] == 2
+    assert g["areal_gsmgr_weight_sync_fanout_secs"] == 1.5
+    assert js["weight_sync_fanout_secs"] == 1.5
+    for c, n in js["inflight_by_class"].items():
+        assert g[f"areal_gsmgr_inflight_{c}"] == n
+    # every sample line parses as "name{labels} value"
+    for ln in prom_text.splitlines():
+        if ln and not ln.startswith("#"):
+            float(ln.rpartition(" ")[2])
+
+
+def test_genserver_metrics_parity(tmp_name_resolve):
+    import asyncio
+
+    jax = pytest.importorskip("jax")
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+
+    cfg = tiny_config(vocab_size=97)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    srv = GenerationServer(
+        GenerationServerConfig(experiment="par", trial="t0",
+                               chunk_tokens=4, prompt_bucket=8),
+        cfg, params,
+    )
+    srv._tokens_out = 123
+    srv._prefill_tokens = 45
+    srv.version = 3
+    srv._inflight = 2
+
+    async def both():
+        prom = await srv.handle_metrics(None)
+        js = await srv.handle_metrics_json(None)
+        return prom.text, json.loads(js.text)
+
+    prom_text, js = asyncio.run(both())
+    g = _prom_gauges(prom_text, "areal_genserver_")
+    assert g["areal_genserver_generated_tokens"] == js[
+        "generated_tokens"] == 123
+    assert g["areal_genserver_prefill_tokens"] == js["prefill_tokens"] == 45
+    assert g["areal_genserver_weight_version"] == js["version"] == 3
+    assert g["areal_genserver_inflight_requests"] == js[
+        "inflight_requests"] == 2
+    assert g["areal_genserver_queue_depth"] == js["queue_depth"]
+    assert g["areal_genserver_kv_states"] == js["kv_states"]
+    assert g["areal_genserver_compiled_shapes"] == js["compiled_shapes"]
+    for ln in prom_text.splitlines():
+        if ln and not ln.startswith("#"):
+            float(ln.rpartition(" ")[2])

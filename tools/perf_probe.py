@@ -8,6 +8,17 @@ Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
   scrape <url>                        GET a worker's /metrics (Prometheus
                                       text or JSON) and pretty-print it
+  scrape <exp> <trial>                same, against the aggregator's
+                                      MERGED fleet endpoint (resolved via
+                                      name-resolve; fails with a clear
+                                      message when telemetry is disabled
+                                      or http_port is 0)
+  trace <traces.jsonl> <trace_id>     print a stitched sample-lineage
+                                      trace as a critical-path timeline
+                                      (docs/observability.md)
+  flight-dump <exp> <trial> <dir>     ask EVERY live worker to dump its
+                                      flight-recorder ring to
+                                      <dir>/flight_<worker>.jsonl
   decode-bench <server_url> [n_requests] [max_tokens]
                                       drive a LIVE generation server with
                                       a mixed-class synthetic workload
@@ -36,15 +47,20 @@ def scrape(url: str) -> None:
     renders as an aligned table (histograms summarized as count/mean);
     JSON (e.g. /metrics.json) pretty-prints as-is."""
     import json as _json
+    import urllib.error
     import urllib.request
 
     if not url.startswith("http"):
         url = f"http://{url}"
     if "/metrics" not in url:
         url = url.rstrip("/") + "/metrics"
-    with urllib.request.urlopen(url, timeout=10) as r:
-        ctype = r.headers.get("Content-Type", "")
-        body = r.read().decode()
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read().decode()
+    except (urllib.error.URLError, OSError) as e:
+        sys.exit(f"scrape: cannot reach {url}: {e}\n"
+                 f"(is the worker up, and telemetry enabled?)")
     if "json" in ctype:
         print(_json.dumps(_json.loads(body), indent=2, sort_keys=True))
         return
@@ -148,6 +164,77 @@ def decode_bench(server_url: str, n_requests: int = 24,
           f"prefill_tokens={m.get('prefill_tokens')}")
 
 
+def scrape_fleet(experiment: str, trial: str) -> None:
+    """Resolve + scrape the aggregator's MERGED fleet /metrics (the
+    telemetry.http_port endpoint). jax-free; fails with an actionable
+    message — not a traceback — when telemetry is off."""
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        url = name_resolve.get(names.telemetry_http(experiment, trial))
+    except Exception:  # noqa: BLE001 — key absent: telemetry off/no port
+        sys.exit(
+            f"scrape: no merged telemetry endpoint registered for "
+            f"{experiment}/{trial}.\nEither telemetry is disabled or the "
+            f"aggregator has no HTTP port — relaunch with "
+            f"telemetry.enabled=true telemetry.http_port=<port>, or "
+            f"scrape a worker endpoint directly: scrape <url>."
+        )
+    print(f"[scrape] merged fleet endpoint {url}")
+    scrape(url)
+
+
+def print_trace(traces_path: str, trace_id: str) -> None:
+    """Reconstruct one stitched trace from ``traces.jsonl`` as a
+    chronological critical-path timeline: per-span offset from the
+    prompt's admission, duration, owning worker — then the derived stage
+    decomposition (generate/queue/gate/train-wait/train)."""
+    import json as _json
+
+    try:
+        with open(traces_path) as f:
+            recs = [_json.loads(ln) for ln in f if ln.strip()]
+    except OSError as e:
+        sys.exit(f"trace: cannot read {traces_path}: {e}")
+    hits = [r for r in recs if r.get("trace_id") == trace_id]
+    if not hits:
+        known = {r.get("trace_id") for r in recs}
+        sys.exit(f"trace: {trace_id!r} not in {traces_path} "
+                 f"({len(known)} trace ids present)")
+    # The LAST record is the most complete view (each trained sample of
+    # the group re-stitches the trace with everything seen so far).
+    rec = hits[-1]
+    spans = sorted(rec.get("spans", []), key=lambda s: s["t_start"])
+    t0 = rec.get("t_start", spans[0]["t_start"] if spans else 0.0)
+    print(f"trace {trace_id}  sample={rec.get('sample_id')}  "
+          f"weight_version={rec.get('weight_version')}  "
+          f"e2e={rec.get('e2e_secs', 0):.3f}s  "
+          f"workers={','.join(rec.get('workers', []))}")
+    w = max((len(s['name']) for s in spans), default=0)
+    for s in spans:
+        off = s["t_start"] - t0
+        attrs = s.get("attrs") or {}
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items())
+                         if k not in ("error",))
+        print(f"  +{off:8.3f}s  {s['name']:<{w}}  "
+              f"{s['dur_secs'] * 1e3:9.1f}ms  [{s.get('worker', '?')}]"
+              f"{('  ' + extra) if extra else ''}")
+    stages = rec.get("stages") or {}
+    if stages:
+        print("  stages: " + "  ".join(
+            f"{k}={v:.3f}s" for k, v in stages.items()
+        ))
+
+
+def flight_dump(experiment: str, trial: str, out_dir: str) -> None:
+    from areal_tpu.base import telemetry
+
+    nonce = telemetry.request_flight_dump(experiment, trial, out_dir)
+    print(f"flight-dump trigger {nonce} set for {experiment}/{trial}: "
+          f"every worker dumps flight_<worker>.jsonl into {out_dir} "
+          f"within one telemetry flush interval (~2s at defaults)")
+
+
 def profile_trigger(experiment: str, trial: str, out_dir: str,
                     secs: float = 5.0) -> None:
     from areal_tpu.base import telemetry
@@ -166,13 +253,21 @@ def profile_status(experiment: str, trial: str) -> None:
 
 
 def _dispatch_fleet_commands(argv) -> bool:
-    if not argv or argv[0] not in ("scrape", "decode-bench",
+    if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
+                                   "flight-dump",
                                    "profile-trigger", "profile-status"):
         return False
     cmd = argv[0]
     try:
         if cmd == "scrape":
-            scrape(argv[1])
+            if len(argv) > 2:
+                scrape_fleet(argv[1], argv[2])
+            else:
+                scrape(argv[1])
+        elif cmd == "trace":
+            print_trace(argv[1], argv[2])
+        elif cmd == "flight-dump":
+            flight_dump(argv[1], argv[2], argv[3])
         elif cmd == "decode-bench":
             decode_bench(
                 argv[1],
