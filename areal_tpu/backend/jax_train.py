@@ -187,6 +187,7 @@ class JaxTrainEngine(TrainableEngine):
         attn_impl: str = "auto",
         remat: bool = False,
         logprob_chunk: Optional[int] = 512,
+        fill_bucket: Optional[int] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -194,6 +195,9 @@ class JaxTrainEngine(TrainableEngine):
         self.length_bucket = length_bucket
         self.rows_bucket = rows_bucket
         self.seqs_bucket = seqs_bucket
+        # Candidate row-length granularity for the packer's fill sweep
+        # (None = packer default, min(length_bucket, 128)).
+        self.fill_bucket = fill_bucket
         self.attn_impl = attn_impl
         self.remat = remat
         # Column-chunk size for the chunked-logprob head (None disables);
@@ -448,7 +452,9 @@ class JaxTrainEngine(TrainableEngine):
             mbs = mbu.split_into_microbatches(
                 input_, mb_spec, length_bucket=self.length_bucket,
                 rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+                fill_bucket=self.fill_bucket,
             )
+            telemetry.set_gauge("train/pack_fill", mbu.pack_fill(mbs))
         R, L = mbs[0].layout.shape
         S = max(len(mb.seq_mask) for mb in mbs)
         S = mbu.packing.round_up(S, self.seqs_bucket)
@@ -670,7 +676,9 @@ class JaxTrainEngine(TrainableEngine):
             mbs = mbu.split_into_microbatches(
                 input_, mb_spec, length_bucket=self.length_bucket,
                 rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+                fill_bucket=self.fill_bucket,
             )
+            telemetry.set_gauge("train/pack_fill", mbu.pack_fill(mbs))
         weights = [float(loss_weight_fn(mb)) for mb in mbs]
         total_w = sum(weights)
         rule = None
@@ -837,7 +845,9 @@ class JaxTrainEngine(TrainableEngine):
         mbs = mbu.split_into_microbatches(
             input_, mb_spec, length_bucket=self.length_bucket,
             rows_bucket=self.rows_bucket, seqs_bucket=self.seqs_bucket,
+            fill_bucket=self.fill_bucket,
         )
+        telemetry.set_gauge("infer/pack_fill", mbu.pack_fill(mbs))
         use_lp = self._use_chunked_logprobs(post_hook)
         # use_lp is part of the key: id() of a GC'd hook can be reused by a
         # new hook with a different wants_token_logprobs, which would route
@@ -921,6 +931,7 @@ class JaxTrainBackend(ModelBackend):
     attn_impl: str = "auto"
     remat: bool = False
     logprob_chunk: Optional[int] = 512
+    fill_bucket: Optional[int] = None
     train: bool = True
 
     def initialize(self, model: Model, spec: FinetuneSpec) -> Model:
@@ -944,6 +955,7 @@ class JaxTrainBackend(ModelBackend):
             attn_impl=self.attn_impl,
             remat=self.remat,
             logprob_chunk=self.logprob_chunk,
+            fill_bucket=self.fill_bucket,
         )
         model.module = engine
         return model

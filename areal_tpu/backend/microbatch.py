@@ -59,26 +59,43 @@ def split_into_microbatches(
     rows_bucket: int = 8,
     seqs_bucket: int = 8,
     row_len: Optional[int] = None,
+    fill_bucket: Optional[int] = None,
 ) -> List[MicroBatch]:
     """Pack ``sample`` into micro-batches of IDENTICAL ``[R, L]`` grid shape.
 
     Pack-then-split (not split-then-pack): sequences are FFD-packed into
     rows of a single row length L, and rows are grouped R-per-micro-batch
     so every micro-batch compiles to the same shape. L is chosen from the
-    multiples of ``length_bucket`` that fit the longest sequence by
+    multiples of ``fill_bucket`` that fit the longest sequence by
     minimizing total padded cells (measured r3: the old per-mb
     round_up(max_len) layout reached only 0.67 fill on ~1k-token rollouts
     — a third of the MXU work was padding).
+
+    ``fill_bucket`` (default ``min(length_bucket, 128)``) is the candidate
+    row-length granularity — decoupled from ``length_bucket`` in round 8
+    because stepping candidates by a coarse 512 bucket was itself a fill
+    ceiling: at the bench distribution (~700-1000-token trajectories) the
+    only coarse candidates were 1536/2048-token rows at ≤0.85 fill, while
+    the 128-grain sweep finds rows ≥0.92 full under a cap-4096 budget. 128
+    is the floor the Pallas flash kernel's lane width imposes on row
+    lengths. The rows-per-micro-batch choice is swept as well (the old
+    fixed ``cap // L`` wasted up to R-1 padding rows in the last
+    micro-batch). Finer candidates mean the compiled [R, L] shape tracks
+    the length distribution more closely — more distinct shapes across
+    drifting distributions; raise ``fill_bucket`` back toward
+    ``length_bucket`` to trade fill for shape stability.
 
     ``rows_bucket`` is kept for API compatibility; uniform grouping already
     pins the compiled shape set.
     """
     if sample.bs == 0:
         return []
+    if fill_bucket is None:
+        fill_bucket = min(length_bucket, 128)
     seqlens = [int(x) for x in sample.total_lens(token_key)]
     total = sum(seqlens)
     cap = int(mb_spec.max_tokens_per_mb or total)
-    base = packing.round_up(max(seqlens), length_bucket)
+    base = packing.round_up(max(seqlens), fill_bucket)
     cap = max(cap, base)
     if row_len is not None:
         L0 = packing.round_up(row_len, length_bucket)
@@ -88,20 +105,28 @@ def split_into_microbatches(
             )
         cands = [L0]
     else:
-        cands = list(range(base, min(2 * base, cap) + 1, length_bucket))
+        # Bound the sweep: rows much longer than a few multiples of the
+        # longest sequence stop improving fill, and an uncapped token
+        # budget must not turn into an O(total/fill_bucket) FFD sweep.
+        hi = min(cap, max(2 * base, 64 * fill_bucket))
+        cands = list(range(base, hi + 1, fill_bucket))
     min_mbs = mb_spec.n_mbs or 1
     best = None
     for L in cands:
         rows = datapack.ffd_allocate(seqlens, L)
         # Rows per micro-batch: bounded by the token cap AND small enough
-        # that >= mb_spec.n_mbs groups come out (the documented minimum).
-        R = max(min(cap // L, len(rows) // min_mbs), 1)
-        n_mbs = -(-len(rows) // R)
-        cells = n_mbs * R * L
-        # Tie-break toward the smaller row length: less per-row causal
-        # attention waste for the same padded-cell count.
-        if best is None or cells < best[0]:
-            best = (cells, L, R, rows)
+        # that >= mb_spec.n_mbs groups come out (the documented minimum);
+        # swept downward because ceil(len(rows)/R) rounding can pad the
+        # last micro-batch with up to R-1 dead rows.
+        max_R = max(min(cap // L, len(rows) // min_mbs), 1)
+        for R in range(max_R, 0, -1):
+            n_mbs = -(-len(rows) // R)
+            cells = n_mbs * R * L
+            # Strict < keeps the FIRST optimum: the smaller row length
+            # (less per-row causal attention waste) and, within one L, the
+            # larger R (fewer dispatches) for the same padded-cell count.
+            if best is None or cells < best[0]:
+                best = (cells, L, R, rows)
     _, L, R, rows = best
     out = []
     for m in range(0, len(rows), R):
@@ -128,6 +153,16 @@ def split_into_microbatches(
             )
         )
     return out
+
+
+def pack_fill(mbs: List[MicroBatch]) -> float:
+    """Achieved packing fill of a micro-batch split: real tokens over
+    allocated [R, L] cells — the padding factor the reported MFU divides
+    by. Exported as the ``train/pack_fill`` telemetry gauge and in
+    bench.py output (ISSUE 8 / ROADMAP item 1)."""
+    ntok = sum(mb.n_tokens for mb in mbs)
+    ncells = sum(int(np.prod(mb.layout.shape)) for mb in mbs)
+    return (ntok / ncells) if ncells else 0.0
 
 
 def make_microbatch(

@@ -10,7 +10,7 @@ the bottleneck sum, and FFD bin packing for micro-batch assembly.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -79,11 +79,12 @@ def partition_contiguous_balanced(sizes: Sequence[int], k: int) -> List[List[int
     return [list(range(bounds[i], bounds[i + 1])) for i in range(k)]
 
 
-def _ffd_native(sizes: Sequence[int], capacity: int):
+def _ffd_native(sizes: Sequence[int], capacity: int, force: bool = False):
     """Native first-fit-decreasing (csrc/interval_ops.cpp ffd_assign) —
     bit-identical bin contents to the Python loop (same stable decreasing
-    order, same first-fit scan). None → caller runs the Python path."""
-    if len(sizes) < 64:  # ctypes call overhead beats tiny inputs
+    order, same first-fit scan). None → caller runs the Python path.
+    ``force`` bypasses the small-input threshold (parity tests)."""
+    if len(sizes) < 64 and not force:  # ctypes call overhead: tiny inputs
         return None
     try:
         from areal_tpu.ops import native
@@ -103,15 +104,22 @@ def _ffd_native(sizes: Sequence[int], capacity: int):
 
 
 def ffd_allocate(
-    sizes: Sequence[int], capacity: int, min_groups: int = 1
+    sizes: Sequence[int], capacity: int, min_groups: int = 1,
+    use_native: Optional[bool] = None,
 ) -> List[List[int]]:
     """First-fit-decreasing bin packing: group indices so that each group's
     total size is <= capacity (single items larger than capacity get their own
     group), producing at least ``min_groups`` groups when possible.
+
+    ``use_native``: None (default) auto-selects the C fast path for large
+    inputs; True forces it (ignoring the size threshold), False forces the
+    Python loop — the two must produce bit-identical bins (parity-tested).
     """
     bins: List[List[int]] = []
     loads: List[int] = []
-    native_bins = _ffd_native(sizes, capacity)
+    native_bins = None if use_native is False else _ffd_native(
+        sizes, capacity, force=use_native is True
+    )
     if native_bins is not None:
         bins = native_bins
         loads = [sum(int(sizes[i]) for i in b) for b in bins]

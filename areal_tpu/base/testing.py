@@ -97,3 +97,37 @@ def make_code_jsonl(path: str, n: int = 4, seed: int = 0) -> List[dict]:
         for r in records:
             f.write(json.dumps(r) + "\n")
     return records
+
+
+def bench_trajectory_dist(seed: int = 0, n_seq: int = 32):
+    """The bench.py PPO trajectory length distribution — ~250-token prompts
+    + ~640-token generations — as ``(rng, plens, glens)``. The SINGLE
+    source of the recipe: bench.py continues drawing tokens/logprobs from
+    the returned rng (bit-identical to the historical inline code), while
+    ``tools/perf_probe.py packfill`` and tests/test_packing_fill.py build
+    packing-only samples from it. Change it here and every fill number,
+    probe, and the ≥0.92 gate move together."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    plens = rng.randint(200, 257, n_seq)
+    glens = rng.randint(512, 769, n_seq)
+    return rng, plens, glens
+
+
+def bench_trajectory_sample(seed: int = 0, n_seq: int = 32,
+                            vocab: int = 1000):
+    """``(SequenceSample, seqlens)`` carrying only packed_input_ids — what
+    packing-fill consumers of :func:`bench_trajectory_dist` need."""
+    import numpy as np
+
+    from areal_tpu.api.data import SequenceSample
+
+    rng, plens, glens = bench_trajectory_dist(seed, n_seq)
+    seqlens = (plens + glens).astype(int)
+    toks = rng.randint(2, vocab, int(seqlens.sum())).astype(np.int32)
+    return SequenceSample.from_default(
+        ids=[f"b{i}" for i in range(n_seq)],
+        data={"packed_input_ids": toks},
+        seqlens=seqlens.tolist(),
+    ), seqlens

@@ -92,8 +92,16 @@ def packed_attention(
     causal: bool = True,
     sliding_window: Optional[int] = None,
     impl: str = "auto",
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Dispatch between the XLA reference and the Pallas TPU kernel."""
+    """Dispatch between the XLA reference and the Pallas TPU kernel.
+
+    The kernel wrapper itself degrades to the reference for shapes it
+    cannot tile (no 128-multiple block divisor — see
+    ops/pallas/flash_attention.pick_block_sizes) by calling back into this
+    function with ``impl="reference"``, so the except-clause below only
+    handles a missing/broken pallas import. ``scale`` defaults to
+    ``head_dim ** -0.5`` in both implementations."""
     explicit = impl == "pallas"
     if explicit and sliding_window is not None:
         raise NotImplementedError(
@@ -108,7 +116,8 @@ def packed_attention(
 
             return flash_attention(
                 q, k, v, q_segment_ids, kv_segment_ids,
-                q_positions=q_positions, kv_positions=kv_positions, causal=causal,
+                q_positions=q_positions, kv_positions=kv_positions,
+                causal=causal, scale=scale,
             )
         except (ImportError, NotImplementedError) as e:
             if explicit:
@@ -126,7 +135,7 @@ def packed_attention(
         q_segment_ids, kv_segment_ids, q_positions, kv_positions, causal,
         sliding_window=sliding_window,
     )
-    return attention_reference(q, k, v, mask)
+    return attention_reference(q, k, v, mask, scale=scale)
 
 
 def decode_attention(

@@ -16,14 +16,42 @@ areal_tpu's packed-batch semantics:
    contiguous within a row (models/packing.py);
  - head_dim is padded up to the lane width (128) when needed.
 
-CPU/testing: wrap calls in ``pltpu.force_tpu_interpret_mode()`` — the parity
-test (tests/test_pallas_attention.py) runs the same kernel interpreted.
+Block-size selection (the device-efficiency lever named in
+docs/benchmarks.md "Where the time goes"): ``pick_block_sizes`` resolves
+(block_q, block_kv) for a (T, S) geometry from, in precedence order,
+
+ 1. ``AREAL_FLASH_BLOCKS="bq,bkv"`` — a global pin (debug/experiments);
+ 2. a geometry-keyed table: entries recorded at runtime via
+    :func:`set_block_sizes`, or loaded from the JSON file named by
+    ``AREAL_FLASH_BLOCK_TABLE`` (written by ``perf_probe blocksweep``,
+    format ``{"T,S": [bq, bkv]}``);
+ 3. the built-in heuristic — the largest 128-multiple divisor ≤ 512.
+
+Table/env entries are validated against the kernel's divisibility
+constraint and snap DOWN to the nearest dividing 128-multiple rather than
+failing at dispatch time.
+
+Sequence dims with NO 128-multiple divisor no longer raise: the call falls
+back to the XLA reference attention (ops/attention.py) with a once-per-
+process log line. Training shapes never hit this (the packing
+length_bucket guarantees 128-aligned rows); the fallback exists so ad-hoc
+shapes (eval, probes) degrade gracefully instead of crashing.
+
+CPU/testing: wrap calls in ``interpret_mode()`` — on jax versions shipping
+``pltpu.force_tpu_interpret_mode`` the parity test
+(tests/test_pallas_attention.py) runs the same kernel interpreted; on
+jax 0.4.x the pallas interpreter cannot execute this kernel (its
+load-discharge rule chokes on scalar block indices) and the helper
+returns None so tests skip with a reason instead of failing.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import json
+import logging
+import os
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,15 +64,134 @@ from jax.experimental.pallas.ops.tpu.flash_attention import (
 )
 
 LANE = 128
+DEFAULT_BLOCK_TARGET = 512
+
+logger = logging.getLogger("areal_tpu")
+
+# Geometry-keyed (T, S) -> (block_q, block_kv). Populated by
+# set_block_sizes() / the AREAL_FLASH_BLOCK_TABLE JSON (perf_probe
+# blocksweep writes it); empty by default — the heuristic below is the
+# fallback, and recorded sweep results override it per geometry.
+_BLOCK_TABLE: Dict[Tuple[int, int], Tuple[int, int]] = {}
+_TABLE_FILE_LOADED: Optional[str] = None  # set only on a SUCCESSFUL load
+_TABLE_FILE_WARNED: set = set()
+_WARNED_REF_FALLBACK = False
 
 
-def _block(n: int, target: int) -> int:
+def _block(n: int, target: int) -> Optional[int]:
     """Largest multiple of 128 that divides n and is ≤ target (the kernel
-    requires block sizes to divide the sequence dims exactly)."""
+    requires block sizes to divide the sequence dims exactly). None when no
+    such divisor exists — callers fall back to the reference path."""
     for b in range(min(target, n), 0, -LANE):
         if n % b == 0 and b % LANE == 0:
             return b
-    raise NotImplementedError(f"no 128-multiple block divides {n}")
+    return None
+
+
+def set_block_sizes(T: int, S: int, block_q: int, block_kv: int) -> None:
+    """Record tuned block sizes for a (T, S) geometry (process-local)."""
+    _BLOCK_TABLE[(int(T), int(S))] = (int(block_q), int(block_kv))
+
+
+def clear_block_table() -> None:
+    """Drop runtime + file-loaded entries (tests / re-sweeps)."""
+    global _TABLE_FILE_LOADED
+    _BLOCK_TABLE.clear()
+    _TABLE_FILE_LOADED = None
+
+
+def _load_table_file() -> None:
+    """Merge ``AREAL_FLASH_BLOCK_TABLE`` (if set) into the table once per
+    path; runtime set_block_sizes entries win over file entries. A missing
+    or unreadable file warns once but is retried on later calls (the
+    documented workflow writes the file with ``perf_probe blocksweep``
+    AFTER the env var is already exported), and only a successful load
+    pins the path as done."""
+    global _TABLE_FILE_LOADED
+    path = os.environ.get("AREAL_FLASH_BLOCK_TABLE")
+    if not path or path == _TABLE_FILE_LOADED:
+        return
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        for key, val in raw.items():
+            t, s = (int(x) for x in key.split(","))
+            _BLOCK_TABLE.setdefault((t, s), (int(val[0]), int(val[1])))
+        _TABLE_FILE_LOADED = path
+        _TABLE_FILE_WARNED.discard(path)
+    except (OSError, ValueError, KeyError, IndexError) as e:
+        if path not in _TABLE_FILE_WARNED:
+            _TABLE_FILE_WARNED.add(path)
+            logger.warning("AREAL_FLASH_BLOCK_TABLE %r unreadable (%s); "
+                           "using heuristic block sizes until it appears",
+                           path, e)
+
+
+def pick_block_sizes(T: int, S: int) -> Optional[Tuple[int, int]]:
+    """Resolve (block_q, block_kv) for a geometry; None when either dim has
+    no 128-multiple divisor (caller must use the reference path). Env pin >
+    table (runtime or file) > heuristic; every source is snapped down to
+    the nearest dividing 128-multiple."""
+    if _block(T, T) is None or _block(S, S) is None:
+        return None
+    # Any 128-multiple divisor of n implies 128 | n, so once the checks
+    # above pass the heuristic (target 512 >= 128) can never miss — it is
+    # the safe landing spot for out-of-range pins/table entries (a sub-128
+    # pin must NOT snap up to a whole-sequence tile: bq*bkv scores alone
+    # would blow VMEM).
+    heur_q = _block(T, DEFAULT_BLOCK_TARGET)
+    heur_kv = _block(S, DEFAULT_BLOCK_TARGET)
+    env = os.environ.get("AREAL_FLASH_BLOCKS")
+    if env:
+        try:
+            bq, bkv = (int(x) for x in env.split(","))
+            return (_block(T, min(bq, T)) or heur_q,
+                    _block(S, min(bkv, S)) or heur_kv)
+        except ValueError:
+            logger.warning("AREAL_FLASH_BLOCKS=%r not 'bq,bkv'; ignoring",
+                           env)
+    _load_table_file()
+    hit = _BLOCK_TABLE.get((T, S))
+    if hit is not None:
+        return (_block(T, min(hit[0], T)) or heur_q,
+                _block(S, min(hit[1], S)) or heur_kv)
+    return (heur_q, heur_kv)
+
+
+def interpret_mode():
+    """``pltpu.force_tpu_interpret_mode()`` when this jax ships it, else
+    None (jax 0.4.x: the pallas interpreter cannot execute this kernel —
+    ``pl.pallas_call(interpret=True)`` dies in its load-discharge rule on
+    scalar block indices — so CPU parity tests must skip, with this as the
+    single version gate they consult)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    ctx = getattr(pltpu, "force_tpu_interpret_mode", None)
+    return ctx() if ctx is not None else None
+
+
+def _reference_fallback(q, k, v, q_segment_ids, kv_segment_ids,
+                        q_positions, kv_positions, causal, scale, why):
+    global _WARNED_REF_FALLBACK
+    if not _WARNED_REF_FALLBACK:
+        _WARNED_REF_FALLBACK = True
+        logger.warning(
+            "pallas flash attention: %s; falling back to the O(S^2) XLA "
+            "reference for this shape (further fallbacks logged at debug)",
+            why,
+        )
+    else:
+        logger.debug("pallas flash attention fallback: %s", why)
+    # One definition of the reference recipe: route back through the
+    # dispatcher with impl="reference" (no recursion — that path never
+    # re-enters this module).
+    from areal_tpu.ops import attention as attn
+
+    return attn.packed_attention(
+        q, k, v, q_segment_ids, kv_segment_ids, q_positions=q_positions,
+        kv_positions=kv_positions, causal=causal, impl="reference",
+        scale=scale,
+    )
 
 
 @functools.partial(
@@ -63,17 +210,22 @@ def flash_attention(
 ) -> jnp.ndarray:
     B, T, Hq, D = q.shape
     S, Hkv = k.shape[1], k.shape[2]
-    if T % LANE or S % LANE:
-        raise NotImplementedError(
-            f"flash kernel needs 128-aligned sequence dims, got T={T} S={S} "
-            "(the packing length_bucket guarantees this for training shapes)"
+    blocks = pick_block_sizes(T, S)
+    if blocks is None:
+        # No 128-multiple divisor: the kernel cannot tile this shape.
+        # Degrade to the reference instead of raising (training shapes are
+        # length_bucket-aligned and never land here).
+        return _reference_fallback(
+            q, k, v, q_segment_ids, kv_segment_ids, q_positions,
+            kv_positions, causal, scale,
+            f"sequence dims T={T} S={S} have no 128-multiple block",
         )
+    if scale is None:
+        scale = D ** -0.5
     if Hq != Hkv:
         G = Hq // Hkv
         k = jnp.repeat(k, G, axis=2)
         v = jnp.repeat(v, G, axis=2)
-    if scale is None:
-        scale = D ** -0.5
 
     # [B, T, H, D] → [B, H, T, D] kernel layout.
     qt = q.transpose(0, 2, 1, 3)
@@ -90,8 +242,7 @@ def flash_attention(
     # keep them NaN-free by masking afterwards instead.
     seg = SegmentIds(q=q_segment_ids, kv=kv_segment_ids)
 
-    bq = _block(T, 512)
-    bkv = _block(S, 512)
+    bq, bkv = blocks
     sizes = BlockSizes(
         block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bkv,

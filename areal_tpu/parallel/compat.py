@@ -1,0 +1,45 @@
+"""Version compatibility for manual-collective APIs.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map`` after the
+0.4 series, renaming two knobs on the way:
+
+ - ``axis_names={"pp"}``  (manual axes)   was ``auto=<complement>``
+ - ``check_vma=False``    (per-value rep) was ``check_rep=False``
+
+areal_tpu supports both spellings so the parallel layer (pipeline.py,
+ring.py) runs on the jax baked into the TPU image *and* on the 0.4.3x CPU
+test image. All call sites go through :func:`shard_map` below, which takes
+the modern signature and translates when only the experimental API exists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              axis_names: Optional[Set[str]] = None):
+    """``jax.shard_map(..., check_vma=False)`` with a fallback to
+    ``jax.experimental.shard_map.shard_map(..., check_rep=False)``.
+
+    ``axis_names`` is the set of mesh axes the body is MANUAL over (None =
+    all of them); the experimental API expresses the same thing inverted,
+    as the ``auto`` complement set.
+    """
+    modern = getattr(jax, "shard_map", None)
+    if modern is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return modern(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False, **kwargs)
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+            if axis_names is not None else frozenset())
+    return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False, auto=auto)

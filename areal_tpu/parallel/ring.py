@@ -124,10 +124,11 @@ def ring_attention(
     fn = partial(
         _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    from areal_tpu.parallel.compat import shard_map
+
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
         out_specs=qkv_spec,
-        check_vma=False,
     )(q, k, v, segment_ids, segment_ids)

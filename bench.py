@@ -68,11 +68,16 @@ def main():
                                   warmup_steps_proportion=0.0,
                                   mu_dtype="bfloat16", nu_dtype="bfloat16"),
         compute_dtype="bfloat16", length_bucket=512, rows_bucket=4,
-        seqs_bucket=16, remat=False,
-        # At the 2048-token cap the [2,1024,V] logits fit (0.6GB), so the
-        # chunked-logprob head's ~5% recompute buys nothing here; it stays
-        # on by default for inference paths and larger configs.
-        logprob_chunk=None,
+        seqs_bucket=16,
+        # r08 config: the cap-4096 + "dots"-remat + chunked-logprob combo
+        # (ROADMAP item 1 retry). The r05 sweep measured cap-4096 dots ≈
+        # cap-2048 no-remat within noise — but at the packer's old 0.84
+        # fill; the 128-grain fill sweep (backend/microbatch.py) packs the
+        # same trajectories at ≥0.96, so the 4096 cap now buys ~14% more
+        # real tokens per padded FLOP. "dots" keeps matmul outputs and
+        # recomputes only elementwise/norm in backward; the chunked head
+        # drops the [R, L, V] logits grid that no longer fits at L≈1792.
+        remat="dots", logprob_chunk=512,
     )
     model = backend.initialize(model, FinetuneSpec(1, 512, 64))
     # HONESTY NOTE vs BENCH_r04: r4's engine silently trained fully in
@@ -80,18 +85,22 @@ def main():
     # is lighter AND faster but rounds away updates smaller than ~4e-3
     # relative (bf16 mantissa), a silent quality bug for PPO-scale lrs.
     # The engine now keeps explicit f32 masters (backend/jax_train.py);
-    # the bench measures the CORRECT training path, whose best fitting
-    # micro-batch cap on this 16G chip is 2048 tokens.
+    # the bench measures the CORRECT training path. r05-r07 ran it at the
+    # cap-2048 no-remat config (the best fit then); r08 moves to
+    # cap-4096 + "dots"-remat + chunked-logprob, which the "dots" remat
+    # fits in the same budget (see the backend block above).
 
     hp = PPOHyperparameters(ppo_n_minibatches=1, adv_norm=True,
                             kl_ctl=0.0, disable_value=True)
     iface = PPOActorInterface(hp)
 
-    # Synthetic rollout batch: 32 trajectories, 256-token prompt + ~768 gen.
-    rng = np.random.RandomState(0)
+    # Synthetic rollout batch: 32 trajectories, 256-token prompt + ~768 gen
+    # (canonical recipe: base/testing.bench_trajectory_dist — shared with
+    # perf_probe packfill and the packing-fill test gate).
+    from areal_tpu.base.testing import bench_trajectory_dist
+
     n_seq = 32
-    plens = rng.randint(200, 257, n_seq)
-    glens = rng.randint(512, 769, n_seq)
+    rng, plens, glens = bench_trajectory_dist(0, n_seq)
     seqlens = (plens + glens).astype(int)
     total = int(seqlens.sum())
     toks = rng.randint(2, cfg.vocab_size, total).astype(np.int32)
@@ -111,7 +120,22 @@ def main():
         },
         seqlens=seqlens.tolist(),
     )
-    spec = MicroBatchSpec(max_tokens_per_mb=2048)
+    spec = MicroBatchSpec(max_tokens_per_mb=4096)
+
+    # Achieved packing fill (host-only, same packer the train step runs,
+    # parameterized from the SAME backend fields so it cannot desync from
+    # the engine's layout): the padding factor the reported MFU divides
+    # by — tracked in the output so BENCH_r* records the fill lever
+    # alongside tokens/s.
+    from areal_tpu.backend import microbatch as mbu
+
+    pack_mbs = mbu.split_into_microbatches(
+        batch, spec, length_bucket=backend.length_bucket,
+        rows_bucket=backend.rows_bucket, seqs_bucket=backend.seqs_bucket,
+        fill_bucket=backend.fill_bucket,
+    )
+    pack_fill = mbu.pack_fill(pack_mbs)
+    del pack_mbs
 
     iface.train_step(model, batch, spec)  # warmup/compile
     jax.block_until_ready(model.module.params)
@@ -216,6 +240,7 @@ def main():
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
+        "pack_fill": round(pack_fill, 4),
         "weight_sync_latency_s": round(weight_sync_s, 3),
         "weight_sync_io_s": round(weight_sync_io_s, 3),
         "weight_sync_transport_s": round(weight_sync_transport_s, 3),

@@ -4,6 +4,22 @@ dispatch/packing overhead and sweeps the knobs that plausibly gate MFU.
 Usage: python tools/perf_probe.py [probe ...]
 Probes: e2e, grad, phases, mbsweep, remat, trace  (default: e2e grad)
 
+Standalone probes (docs/benchmarks.md Tools):
+  packfill [cap ...]                  HOST-ONLY (no TPU, no jax): packing
+                                      fill of the bench-shaped length
+                                      distribution at each token cap
+                                      (default 2048 4096 8192), new
+                                      128-grain sweep vs the coarse
+                                      512-bucket candidates
+  blocksweep [T] [S] [out.json]       sweep flash-attention (block_q,
+                                      block_kv) at a geometry (default
+                                      the bench grid, 1792x1792) and
+                                      record the winner to out.json
+                                      (default profiles/flash_blocks.json;
+                                      load it via AREAL_FLASH_BLOCK_TABLE)
+                                      — needs a real TPU: the kernel has
+                                      no interpreter on this jax
+
 Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
   scrape <url>                        GET a worker's /metrics (Prometheus
@@ -252,9 +268,147 @@ def profile_status(experiment: str, trial: str) -> None:
     print(st if st is not None else "no capture recorded")
 
 
+def packfill(caps=None) -> None:
+    """Host-only packing-fill probe (ISSUE 8 / ROADMAP item 1): what fill
+    the micro-batch packer achieves on the bench trajectory distribution
+    at each token cap — the padding factor the reported MFU divides by.
+    No TPU and no jax needed; safe to run anywhere."""
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.backend import microbatch as mbu
+    from areal_tpu.base.testing import bench_trajectory_sample
+
+    caps = [int(c) for c in caps] if caps else [2048, 4096, 8192]
+    n_seq = 32
+    batch, seqlens = bench_trajectory_sample(0, n_seq)
+    print(f"[packfill] {n_seq} bench-shaped seqs, "
+          f"{int(seqlens.sum())} tokens, lens "
+          f"{int(seqlens.min())}..{int(seqlens.max())}")
+    for cap in caps:
+        spec = MicroBatchSpec(max_tokens_per_mb=cap)
+        for label, fb in (("fine(128)", None), ("coarse(512)", 512)):
+            mbs = mbu.split_into_microbatches(
+                batch, spec, length_bucket=512, rows_bucket=4,
+                seqs_bucket=16, fill_bucket=fb,
+            )
+            R, L = mbs[0].layout.shape
+            print(f"[packfill] cap={cap:<6} {label:<12} "
+                  f"n_mbs={len(mbs):<3} R={R:<2} L={L:<5} "
+                  f"fill={mbu.pack_fill(mbs):.4f}")
+
+
+def _blocksweep_candidates(T: int, S: int):
+    """All (block_q, block_kv) the kernel accepts at this geometry:
+    128-multiples dividing the respective dim, bounded to keep q/kv tiles
+    within a sane VMEM envelope. Pure + CPU-testable."""
+    from areal_tpu.ops.pallas.flash_attention import LANE
+
+    def divs(n):
+        return [b for b in range(LANE, min(n, 2048) + 1, LANE) if n % b == 0]
+
+    return [(bq, bkv) for bq in divs(T) for bkv in divs(S)]
+
+
+def blocksweep(T: int = 1792, S: int = 1792, out_path: str = None,
+               Hq: int = 14, Hkv: int = 2, D: int = 64, B: int = 2) -> None:
+    """Sweep flash-attention block sizes at a (T, S) geometry — default
+    the bench grid after the r08 fill sweep (L=1792, R=2, Qwen2.5-0.5B
+    heads) — timing fwd+bwd per candidate, and record the winner as a
+    geometry-keyed JSON table consumable via AREAL_FLASH_BLOCK_TABLE."""
+    import json as _json
+    import os as _os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.ops.pallas import flash_attention as fa
+
+    if jax.default_backend() != "tpu":
+        sys.exit(
+            "blocksweep: needs a real TPU — the Pallas kernel has no "
+            "working interpreter on this jax version "
+            "(ops/pallas/flash_attention.interpret_mode). Run on the "
+            "bench chip; results land in the JSON table for "
+            "AREAL_FLASH_BLOCK_TABLE."
+        )
+    # A leftover env pin/table would override every per-candidate
+    # set_block_sizes below — the sweep would time one config N times and
+    # record a meaningless winner. Clear both for the sweep's lifetime.
+    for var in ("AREAL_FLASH_BLOCKS", "AREAL_FLASH_BLOCK_TABLE"):
+        if _os.environ.pop(var, None) is not None:
+            print(f"[blocksweep] ignoring {var} for the sweep", flush=True)
+    fa.clear_block_table()
+    cands = _blocksweep_candidates(T, S)
+    if not cands:
+        sys.exit(f"blocksweep: no 128-multiple blocks divide T={T} S={S}")
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, Hq, D).astype(np.float32) * 0.3,
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D).astype(np.float32) * 0.3,
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D).astype(np.float32) * 0.3,
+                    jnp.bfloat16)
+    # bench-like packing: two docs per row
+    seg = np.ones((B, T), np.int32)
+    seg[:, T // 2:] = 2
+    pos = np.concatenate([np.arange(T // 2), np.arange(T - T // 2)])
+    pos = np.tile(pos, (B, 1)).astype(np.int32)
+    seg, pos = jnp.asarray(seg), jnp.asarray(pos)
+
+    def run(bq, bkv):
+        fa.set_block_sizes(T, S, bq, bkv)
+
+        def loss(q):
+            o = fa.flash_attention(q, k, v, seg, seg, q_positions=pos,
+                                   kv_positions=pos)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(loss))
+        g(q).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = g(q)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / 10
+
+    results = []
+    for bq, bkv in cands:
+        try:
+            dt = run(bq, bkv)
+        except Exception as e:  # noqa: BLE001 — kernel may reject a combo
+            print(f"[blocksweep] bq={bq:<5} bkv={bkv:<5} FAILED: "
+                  f"{type(e).__name__}", flush=True)
+            continue
+        results.append((dt, bq, bkv))
+        print(f"[blocksweep] bq={bq:<5} bkv={bkv:<5} {dt * 1e3:8.2f} ms",
+              flush=True)
+    fa.clear_block_table()
+    if not results:
+        sys.exit("blocksweep: every candidate failed")
+    results.sort()
+    dt, bq, bkv = results[0]
+    heur = fa.pick_block_sizes(T, S)
+    print(f"[blocksweep] winner: bq={bq} bkv={bkv} ({dt * 1e3:.2f} ms; "
+          f"heuristic default was {heur})")
+    out_path = out_path or _os.path.join("profiles", "flash_blocks.json")
+    _os.makedirs(_os.path.dirname(out_path) or ".", exist_ok=True)
+    table = {}
+    if _os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                table = _json.load(f)
+        except (OSError, ValueError):
+            pass
+    table[f"{T},{S}"] = [bq, bkv]
+    with open(out_path, "w") as f:
+        _json.dump(table, f, indent=1, sort_keys=True)
+    print(f"[blocksweep] recorded to {out_path} "
+          f"(use: AREAL_FLASH_BLOCK_TABLE={out_path})")
+
+
 def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
-                                   "flight-dump",
+                                   "flight-dump", "packfill", "blocksweep",
                                    "profile-trigger", "profile-status"):
         return False
     cmd = argv[0]
@@ -273,6 +427,14 @@ def _dispatch_fleet_commands(argv) -> bool:
                 argv[1],
                 int(argv[2]) if len(argv) > 2 else 24,
                 int(argv[3]) if len(argv) > 3 else 32,
+            )
+        elif cmd == "packfill":
+            packfill(argv[1:])
+        elif cmd == "blocksweep":
+            blocksweep(
+                int(argv[1]) if len(argv) > 1 else 1792,
+                int(argv[2]) if len(argv) > 2 else 1792,
+                argv[3] if len(argv) > 3 else None,
             )
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
