@@ -33,6 +33,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 # never drags in jax/optax (CPU-only children, `--help`).
 from areal_tpu.api.train_config import (  # noqa: F401
     ExperimentSaveEvalControl,
+    FaultToleranceConfig,
     OptimizerConfig,
     ServingConfig,
     TelemetryConfig,
@@ -208,6 +209,12 @@ class BaseExperimentConfig:
     # cross-request prefix-reuse KV, bounded compile-shape bucketing, and
     # per-class latency SLO histograms on the generation servers.
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # Launcher-level supervision + liveness leases (docs/fault_tolerance.md):
+    # per-worker respawn with backoff + crash-loop circuit breaker for the
+    # stateless domain, graceful SIGTERM drain, keepalive heartbeats.
+    fault_tolerance: FaultToleranceConfig = dataclasses.field(
+        default_factory=FaultToleranceConfig
+    )
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10
     # Test-only: use the deterministic mock tokenizer instead of HF.

@@ -149,6 +149,45 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class FaultToleranceConfig:
+    """Launcher-level supervision + liveness (system/supervisor.py,
+    docs/fault_tolerance.md).
+
+    The supervisor classifies child death by failure domain: stateless
+    workers (rollout workers, the gen-fleet process) are respawned in
+    place with exponential backoff behind a crash-loop circuit breaker;
+    stateful workers (trainer) escalate to the whole-experiment
+    ``recover_mode=auto`` relaunch. Liveness is grounded in name-resolve
+    keepalive leases: supervised workers register their advertisements
+    with ``keepalive_ttl_secs`` and heartbeat them from a dedicated
+    thread, so a SIGKILLed worker's ghost keys expire instead of being
+    addressed forever."""
+
+    # False restores the legacy behavior: ANY child death tears the
+    # experiment down (run_experiment's relaunch loop still applies).
+    supervise: bool = True
+    # Crash-loop circuit breaker: more than this many restarts of one
+    # worker inside the rolling window escalates to a full relaunch.
+    max_restarts: int = 3
+    restart_window_secs: float = 300.0
+    # Respawn backoff (per worker, reset outside the window).
+    backoff_base_secs: float = 0.5
+    backoff_max_secs: float = 30.0
+    backoff_multiplier: float = 2.0
+    # Liveness lease on worker/stream advertisements (0 disables leases;
+    # heartbeats default to ttl/3).
+    keepalive_ttl_secs: float = 15.0
+    heartbeat_interval_secs: float = 0.0
+    # Graceful drain (SIGTERM): budget for pause -> out-of-band recover
+    # checkpoint -> orderly exits before falling back to terminate().
+    drain_timeout_secs: float = 60.0
+    # Backoff between whole-experiment relaunch attempts
+    # (run_experiment's recover_mode=auto/fault loop).
+    relaunch_backoff_secs: float = 5.0
+    relaunch_backoff_max_secs: float = 60.0
+
+
+@dataclasses.dataclass
 class ExperimentSaveEvalControl:
     """Reference cli_args.py:702."""
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -214,23 +215,51 @@ def rollout_entry(exp_cfg, rollout_cfg, force_cpu: bool) -> None:
 
 
 class LocalLauncher:
-    """Spawn workers, run the master inline, monitor, tear down."""
+    """Spawn workers, run the master inline, supervise, tear down.
+
+    Child death is classified by failure domain (system/supervisor.py):
+    rollout workers and the gen-fleet process are respawned in place with
+    backoff behind a crash-loop circuit breaker; trainer death escalates
+    to ``run_experiment``'s whole-experiment recovery loop. SIGTERM
+    triggers a graceful drain (pause → out-of-band recover checkpoint →
+    orderly exits) instead of raw terminate().
+    """
 
     def __init__(self, exp_cfg, force_cpu: Optional[bool] = None):
+        from areal_tpu.api.train_config import FaultToleranceConfig
+
         self.exp_cfg = exp_cfg
         # Tests force CPU everywhere; real runs use the native platform.
         self.force_cpu = (
             force_cpu if force_cpu is not None
             else bool(getattr(exp_cfg, "mock_tokenizer", False))
         )
-        self.procs: List[mp.process.BaseProcess] = []
+        self.ft = (getattr(exp_cfg, "fault_tolerance", None)
+                   or FaultToleranceConfig())
+        self.supervisor = None  # built in run() once the trial resolves
+        self._drain_evt = threading.Event()
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_deadline: Optional[float] = None
+        self._drain_failed = False
 
-    def _spawn(self, target, *args, name: str) -> None:
-        ctx = mp.get_context("spawn")
-        p = ctx.Process(target=target, args=args, daemon=True, name=name)
-        p.start()
-        self.procs.append(p)
-        logger.info(f"spawned {name} (pid {p.pid})")
+    def request_drain(self) -> None:
+        """Ask for a graceful drain (same path as SIGTERM): pause the
+        rollout fleet, dump a recover checkpoint out-of-band, exit the
+        workers in order. Safe from any thread / signal handler."""
+        self._drain_evt.set()
+
+    @property
+    def procs(self) -> List[mp.process.BaseProcess]:
+        return self.supervisor.procs() if self.supervisor else []
+
+    def _spawn(self, target, *args, name: str, kind: str,
+               required: bool = True) -> None:
+        from areal_tpu.system.supervisor import WorkerSpec
+
+        self.supervisor.spawn(WorkerSpec(
+            name=name, kind=kind, target=target, args=args,
+            required=required,
+        ))
 
     @staticmethod
     def _count_chips(exp) -> int:
@@ -254,20 +283,53 @@ class LocalLauncher:
             return int(getattr(exp, "n_gpus_per_node", 1))
 
     def _check_children(self) -> None:
-        for p in self.procs:
-            if not p.is_alive() and p.exitcode not in (0, None):
-                raise RuntimeError(
-                    f"worker {p.name} died with exit code {p.exitcode}"
-                )
+        """One supervision sweep. Stateless-domain deaths respawn in
+        place; stateful deaths and crash loops raise
+        SupervisorEscalation, which run_experiment's recover loop turns
+        into a whole-experiment relaunch."""
+        self.supervisor.check()
+
+    def _install_sigterm(self):
+        """Preemption hook: SIGTERM drives the graceful drain instead of
+        killing children outright. Returns a restore callable; no-op off
+        the main thread (in-process test launches)."""
+        import signal
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def on_term(signum, frame):
+                logger.warning("SIGTERM: starting graceful drain")
+                self._drain_evt.set()
+
+            signal.signal(signal.SIGTERM, on_term)
+            return lambda: signal.signal(signal.SIGTERM, prev)
+        except ValueError:  # not the main thread
+            return lambda: None
 
     def run(self) -> Dict[str, Any]:
         from areal_tpu.experiments import common as C
         from areal_tpu.system.master_worker import MasterWorker
+        from areal_tpu.system.supervisor import RestartPolicy, Supervisor
 
         exp = self.exp_cfg
         exp.resolve_trial_name()
         C.setup_name_resolve(exp)
         enable_compilation_cache()  # master runs in-process
+        self.supervisor = Supervisor(
+            exp.experiment_name, exp.trial_name,
+            policy=RestartPolicy.from_config(self.ft),
+            keepalive_ttl=getattr(self.ft, "keepalive_ttl_secs", 0.0),
+            heartbeat_interval=getattr(
+                self.ft, "heartbeat_interval_secs", 0.0
+            ),
+            # supervise=False restores the legacy contract: any child
+            # death (of any kind) escalates immediately.
+            restartable_kinds=(
+                ("rollout", "gen_fleet")
+                if getattr(self.ft, "supervise", True) else ()
+            ),
+        )
         setup = exp.initial_setup()
 
         # Persist the merged config next to the run (reference main_*.py).
@@ -326,19 +388,25 @@ class LocalLauncher:
                     exp, "trainer_dist_devices_per_proc", None
                 )
                 self._spawn(trainer_entry, exp, tc, self.force_cpu,
-                            name=f"trainer{r}")
+                            name=f"trainer{r}", kind="trainer")
         else:
             self._spawn(trainer_entry, exp, setup["trainer"], self.force_cpu,
-                        name="trainer")
+                        name="trainer", kind="trainer")
         if "gen_servers" in setup:
             self._spawn(
                 gen_fleet_entry, exp, setup["gen_servers"],
                 setup["gserver_manager"], self.force_cpu, chips["gen"],
-                name="gen_fleet",
+                name="gen_fleet", kind="gen_fleet",
             )
             for i, rc in enumerate(setup["rollout_workers"]):
+                # A bounded worker (max_rollouts set) finishing its quota
+                # exits 0 by DESIGN — only unbounded workers' clean exits
+                # are the silent data-starvation failure the supervisor
+                # must catch.
                 self._spawn(rollout_entry, exp, rc, self.force_cpu,
-                            name=f"rollout{i}")
+                            name=f"rollout{i}", kind="rollout",
+                            required=getattr(rc, "max_rollouts",
+                                             None) is None)
 
         evaluator = None
         if getattr(exp, "auto_eval", False):
@@ -387,17 +455,17 @@ class LocalLauncher:
                         f"{setup['master'].save_dir} (data: {eval_data})")
 
         master = MasterWorker(setup["master"], setup["dfg"])
+        restore_sigterm = self._install_sigterm()
         try:
             result = self._run_master_monitored(master)
         finally:
+            restore_sigterm()
             if evaluator is not None:
                 evaluator.stop()
             self.shutdown()
         return result
 
     def _run_master_monitored(self, master) -> Dict[str, Any]:
-        import threading
-
         result: Dict[str, Any] = {}
         err: List[BaseException] = []
 
@@ -410,21 +478,63 @@ class LocalLauncher:
         t = threading.Thread(target=run, daemon=True)
         t.start()
         while t.is_alive():
+            if self._drain_evt.is_set() and self._drain_thread is None:
+                self._start_drain()
+            if self._drain_deadline is not None and (
+                self._drain_failed
+                or time.monotonic() > self._drain_deadline
+            ):
+                # The graceful path died or overran its budget while the
+                # master kept running — a silently-dropped SIGTERM would
+                # train until the preemptor SIGKILLs with no checkpoint.
+                # Raise so the finally-path shutdown() tears the children
+                # down now (the caller sees a failed run, as it should).
+                raise RuntimeError(
+                    "graceful drain failed or timed out; forcing teardown"
+                )
             self._check_children()
             t.join(timeout=1.0)
         if err:
             raise err[0]
         return result
 
+    def _start_drain(self) -> None:
+        """Graceful drain in a side thread: the monitor loop keeps
+        watching children while the panel sequence (pause → checkpoint →
+        exit) runs; the master thread returning normally ends the run.
+        The monitor loop enforces the fallback: if this thread fails (or
+        the master is still alive well past the drain budget), the run
+        is torn down rather than left training through its preemption
+        notice."""
+        from areal_tpu.system.supervisor import drain_experiment
+
+        exp = self.exp_cfg
+        self.supervisor.begin_drain()
+        budget = getattr(self.ft, "drain_timeout_secs", 60.0)
+        # 2x: the drain sequence itself is bounded by `budget`; the extra
+        # slack covers the master finishing its finalization afterwards.
+        self._drain_deadline = time.monotonic() + 2 * budget
+
+        def _drain():
+            try:
+                drain_experiment(
+                    exp.experiment_name, exp.trial_name, timeout=budget,
+                )
+            except Exception as e:  # noqa: BLE001 — monitor loop enforces
+                logger.warning(f"graceful drain failed ({e}); the monitor "
+                               "loop will force teardown")
+                self._drain_failed = True
+
+        self._drain_thread = threading.Thread(target=_drain, daemon=True)
+        self._drain_thread.start()
+
     def shutdown(self) -> None:
-        for p in self.procs:
-            if p.is_alive():
-                p.terminate()
-        deadline = time.monotonic() + 10
-        for p in self.procs:
-            p.join(timeout=max(0.1, deadline - time.monotonic()))
-            if p.is_alive():
-                p.kill()
+        if self._drain_thread is not None:
+            self._drain_thread.join(
+                timeout=getattr(self.ft, "drain_timeout_secs", 60.0)
+            )
+        if self.supervisor is not None:
+            self.supervisor.shutdown(timeout=10.0)
 
 
 def run_experiment(exp_cfg) -> Dict[str, Any]:
@@ -452,6 +562,9 @@ def run_experiment(exp_cfg) -> Dict[str, Any]:
         getattr(exp_cfg, "recover_retries", 1)
         if recover_mode in ("auto", "fault") else 0
     )
+    ft = getattr(exp_cfg, "fault_tolerance", None)
+    base = getattr(ft, "relaunch_backoff_secs", 5.0)
+    cap = getattr(ft, "relaunch_backoff_max_secs", 60.0)
     attempt = 0
     while True:
         try:
@@ -460,8 +573,24 @@ def run_experiment(exp_cfg) -> Dict[str, Any]:
             attempt += 1
             if attempt > retries:
                 raise
+            backoff = min(base * 2 ** (attempt - 1), cap)
             logger.warning(
                 f"experiment failed (attempt {attempt}/{retries}); "
-                "re-launching with recovery"
+                f"re-launching with recovery in {backoff:.1f}s"
             )
+            # The dead incarnation's endpoints (streams, worker control,
+            # server urls, model_version) are poison for the relaunch: a
+            # new worker resolving them would hang against closed sockets.
+            # Clear the whole trial subtree — every live registration
+            # belongs to workers the launcher just tore down, and the new
+            # incarnation re-registers everything it needs.
+            try:
+                from areal_tpu.base import name_resolve, names
+
+                name_resolve.clear_subtree(names.trial_root(
+                    exp_cfg.experiment_name, exp_cfg.trial_name
+                ))
+            except Exception as e:  # noqa: BLE001 — best-effort hygiene
+                logger.warning(f"stale name_resolve clear failed: {e}")
+            time.sleep(backoff)
             exp_cfg.recover_mode = "resume"

@@ -802,14 +802,27 @@ class JaxTrainEngine(TrainableEngine):
                 return {k: z[k] for k in z.files}
         raise FileNotFoundError(path)
 
+    @staticmethod
+    def _restore_leaf(v, o):
+        """Restore one checkpoint leaf in the live leaf's image: dtype,
+        SHAPE (safetensors round-trips 0-d scalars as (1,)), and —
+        critically — COMMITMENT. Live opt_state leaves are uncommitted
+        (jit re-places them next to the sharded params); committing them
+        to their current single device on restore pins them there, and
+        the next meshed train step dies with "incompatible devices"
+        (params on the whole mesh vs opt leaves on device 0)."""
+        arr = np.asarray(v).astype(o.dtype).reshape(o.shape)
+        if getattr(o, "_committed", True):
+            return jax.device_put(arr, o.sharding)
+        return jax.device_put(arr)  # device=None: stays uncommitted
+
     def load_train_state(self, ckpt_dir: str) -> None:
         z = self._load_leaf_file(os.path.join(ckpt_dir, "params.safetensors"))
         leaves = [z[f"p{i}"] for i in range(len(z))]
         treedef = jax.tree_util.tree_structure(self.params)
         old = jax.tree_util.tree_leaves(self.params)
         self.params = jax.tree_util.tree_unflatten(treedef, [
-            jax.device_put(np.asarray(v).astype(o.dtype), o.sharding)
-            for v, o in zip(leaves, old)
+            self._restore_leaf(v, o) for v, o in zip(leaves, old)
         ])
         try:
             z = self._load_leaf_file(
@@ -827,8 +840,7 @@ class JaxTrainEngine(TrainableEngine):
                 f"vs live {len(old)}"
             )
             self.opt_state = jax.tree_util.tree_unflatten(treedef, [
-                jax.device_put(np.asarray(v).astype(o.dtype), o.sharding)
-                for v, o in zip(o_leaves, old)
+                self._restore_leaf(v, o) for v, o in zip(o_leaves, old)
             ])
 
     def forward(

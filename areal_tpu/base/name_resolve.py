@@ -59,6 +59,13 @@ class NameRecordRepository:
     def get(self, name: str) -> str:
         raise NotImplementedError()
 
+    def touch(self, name: str) -> None:
+        """Refresh a key's keepalive lease (no-op for keys registered
+        without ``keepalive_ttl``). Raises NameEntryNotFoundError when the
+        key is absent or its lease already expired — the caller's
+        registration is gone and must be re-added, not refreshed."""
+        raise NotImplementedError()
+
     def delete(self, name: str) -> None:
         raise NotImplementedError()
 
@@ -113,22 +120,46 @@ class NameRecordRepository:
 
 class MemoryNameRecordRepo(NameRecordRepository):
     def __init__(self):
-        self._store: Dict[str, str] = {}
+        # name -> (value, expiry_monotonic_or_None, ttl_or_None)
+        self._store: Dict[str, tuple] = {}
         self._lock = threading.Lock()
 
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
         name = name.rstrip("/")
         with self._lock:
+            self._purge_expired_locked(name)
             if name in self._store and not replace:
                 raise NameEntryExistsError(name)
-            self._store[name] = str(value)
+            expiry = (
+                time.monotonic() + keepalive_ttl if keepalive_ttl else None
+            )
+            self._store[name] = (str(value), expiry, keepalive_ttl)
+
+    def _purge_expired_locked(self, name) -> bool:
+        """True iff the key existed but its lease had expired (purged)."""
+        rec = self._store.get(name)
+        if rec is None:
+            return False
+        if rec[1] is not None and time.monotonic() > rec[1]:
+            del self._store[name]
+            return True
+        return False
 
     def get(self, name):
         name = name.rstrip("/")
         with self._lock:
-            if name not in self._store:
+            if self._purge_expired_locked(name) or name not in self._store:
                 raise NameEntryNotFoundError(name)
-            return self._store[name]
+            return self._store[name][0]
+
+    def touch(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if self._purge_expired_locked(name) or name not in self._store:
+                raise NameEntryNotFoundError(name)
+            value, _, ttl = self._store[name]
+            if ttl:
+                self._store[name] = (value, time.monotonic() + ttl, ttl)
 
     def delete(self, name):
         with self._lock:
@@ -149,12 +180,18 @@ class MemoryNameRecordRepo(NameRecordRepository):
     def get_subtree(self, root):
         with self._lock:
             return [
-                v for k, v in sorted(self._store.items()) if self._under(k, root)
+                self._store[k][0] for k in sorted(self._store)
+                if self._under(k, root)
+                and not self._purge_expired_locked(k)
             ]
 
     def find_subtree(self, root):
         with self._lock:
-            return sorted(k for k in self._store if self._under(k, root))
+            return sorted(
+                k for k in list(self._store)
+                if self._under(k, root)
+                and not self._purge_expired_locked(k)
+            )
 
     def reset(self):
         with self._lock:
@@ -175,31 +212,89 @@ class NfsNameRecordRepo(NameRecordRepository):
         name = name.strip("/")
         return os.path.join(self._root, name, "ENTRY")
 
+    @staticmethod
+    def _ttl_path(entry_path: str) -> str:
+        # Keepalive sidecar: the lease TTL in seconds; the ENTRY file's
+        # mtime is the heartbeat timestamp (touch() refreshes it).
+        return os.path.join(os.path.dirname(entry_path), "TTL")
+
+    def _lease_expired(self, path: str) -> bool:
+        ttl_path = self._ttl_path(path)
+        try:
+            with open(ttl_path) as f:
+                ttl = float(f.read().strip())
+            age = time.time() - os.path.getmtime(path)
+        except (OSError, ValueError):
+            return False  # no lease on this key (or racing deletion)
+        return ttl > 0 and age > ttl
+
+    def _purge_expired(self, name: str) -> None:
+        logger.warning(f"name_resolve lease expired: {name}")
+        try:
+            self.delete(name)
+        except (NameEntryNotFoundError, OSError):
+            pass  # another observer purged it first
+
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
         path = self._path(name)
-        if os.path.exists(path) and not replace:
+        if os.path.exists(path) and not (replace or self._lease_expired(path)):
             raise NameEntryExistsError(name)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(str(value))
+        # ENTRY first, TTL sidecar second. The other order opens a purge
+        # race: a concurrent reader sees the NEW ttl against the STALE
+        # entry's old mtime, judges the lease expired, and deletes the
+        # just-written sidecar — leaving the re-registration permanently
+        # lease-less (its ghost would never expire after a later kill).
+        # This order's transient states are safe: fresh ENTRY + old TTL
+        # is unexpired (fresh mtime), and ENTRY with no TTL yet is just
+        # momentarily lease-less.
         os.replace(tmp, path)
+        ttl_path = self._ttl_path(path)
+        if keepalive_ttl:
+            with open(ttl_path + f".tmp{os.getpid()}", "w") as f:
+                f.write(repr(float(keepalive_ttl)))
+            os.replace(ttl_path + f".tmp{os.getpid()}", ttl_path)
+        elif os.path.exists(ttl_path):
+            # Re-registration WITHOUT a lease must not inherit the dead
+            # predecessor's TTL and expire out from under the new owner.
+            try:
+                os.remove(ttl_path)
+            except OSError:
+                pass
         if delete_on_exit:
             self._to_delete.append(name)
 
     def get(self, name):
         path = self._path(name)
         try:
+            if self._lease_expired(path):
+                self._purge_expired(name)
+                raise NameEntryNotFoundError(name)
             with open(path) as f:
                 return f.read()
         except FileNotFoundError:
             raise NameEntryNotFoundError(name) from None
+
+    def touch(self, name):
+        path = self._path(name)
+        if not os.path.exists(path) or self._lease_expired(path):
+            raise NameEntryNotFoundError(name)
+        os.utime(path, None)
 
     def delete(self, name):
         path = self._path(name)
         if not os.path.exists(path):
             raise NameEntryNotFoundError(name)
         os.remove(path)
+        ttl_path = self._ttl_path(path)
+        if os.path.exists(ttl_path):
+            try:
+                os.remove(ttl_path)
+            except OSError:
+                pass
         # Prune empty dirs up to root.
         d = os.path.dirname(path)
         while d != self._root and not os.listdir(d):
@@ -217,11 +312,22 @@ class NfsNameRecordRepo(NameRecordRepository):
         for dirpath, _dirnames, filenames in os.walk(base):
             if "ENTRY" in filenames:
                 rel = os.path.relpath(dirpath, self._root)
-                out.append(rel.replace(os.sep, "/"))
+                key = rel.replace(os.sep, "/")
+                path = os.path.join(dirpath, "ENTRY")
+                if self._lease_expired(path):
+                    self._purge_expired(key)
+                    continue
+                out.append(key)
         return sorted(out)
 
     def get_subtree(self, root):
-        return [self.get(k) for k in self.find_subtree(root)]
+        out = []
+        for k in self.find_subtree(root):
+            try:
+                out.append(self.get(k))
+            except NameEntryNotFoundError:
+                pass  # purged between the walk and the read
+        return out
 
     def reset(self):
         for name in self._to_delete:
@@ -268,6 +374,10 @@ def add_subentry(name, value, **kwargs):
 
 def get(name):
     return DEFAULT_REPO.get(name)
+
+
+def touch(name):
+    return DEFAULT_REPO.touch(name)
 
 
 def delete(name):

@@ -115,6 +115,26 @@ def flight_dump_trigger(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/flight_dump_trigger"
 
 
+def worker_heartbeat(experiment: str, trial: str, worker: str) -> str:
+    """Liveness heartbeat of one worker: JSON {ts, incarnation, pid},
+    rewritten every heartbeat interval by the worker's HeartbeatThread
+    (system/worker_base.py). Observers derive heartbeat AGE from ``ts``;
+    the incarnation id distinguishes a respawned worker from its dead
+    predecessor's ghost."""
+    return f"{_base(experiment, trial)}/heartbeat/{worker}"
+
+
+def worker_heartbeat_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/heartbeat/"
+
+
+def drain_status(experiment: str, trial: str) -> str:
+    """Graceful-drain phase marker written by supervisor.drain_experiment
+    (JSON {phase, ts}): pausing -> checkpoint -> exiting -> done. Read by
+    tools/perf_probe.py fleet-status."""
+    return f"{_base(experiment, trial)}/drain_status"
+
+
 def metric_server(experiment: str, trial: str, group: str, index: str) -> str:
     return f"{_base(experiment, trial)}/metrics/{group}/{index}"
 

@@ -465,6 +465,25 @@ def _prom_name(name: str) -> str:
     return ("_" + s) if s and s[0].isdigit() else (s or "_")
 
 
+def _metric_key_labels(key: str):
+    """Split an optional inline label suffix off a registry metric key:
+    ``supervisor/restarts{worker_kind=rollout}`` → (``supervisor/restarts``,
+    {"worker_kind": "rollout"}). Lets call sites emit one metric FAMILY
+    with several label values (the Prometheus idiom) through the flat
+    string-keyed registry; keys without a suffix return (key, None)."""
+    if not key.endswith("}"):
+        return key, None
+    base, brace, rest = key.partition("{")
+    if not brace:
+        return key, None
+    labels: Dict[str, str] = {}
+    for part in rest[:-1].split(","):
+        k, eq, v = part.partition("=")
+        if eq:
+            labels[k.strip()] = v.strip().strip('"')
+    return base, (labels or None)
+
+
 def _prom_labels(labels: Optional[Dict[str, str]],
                  extra: Optional[Dict[str, str]] = None) -> str:
     merged = {**(labels or {}), **(extra or {})}
@@ -500,10 +519,13 @@ def render_prometheus(
     lines: List[str] = []
     snapshot = snapshot or {}
     lab = _prom_labels(labels)
+    typed = set()  # one # TYPE line per family, even with inline labels
 
     def emit(name: str, kind: str, value: float,
              label_str: Optional[str] = None) -> None:
-        lines.append(f"# TYPE {name} {kind}")
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name}{lab if label_str is None else label_str} "
                      f"{float(value):g}")
 
@@ -517,28 +539,37 @@ def render_prometheus(
         emitted.add(name)
         emit(name, "gauge", float(v))
     for k, v in sorted(snapshot.get("gauges", {}).items()):
-        name = f"{prefix}_{_prom_name(k)}"
-        if name in emitted:
+        base, kl = _metric_key_labels(k)
+        name = f"{prefix}_{_prom_name(base)}"
+        if name in emitted and kl is None:
             # extra_gauges win: a registry gauge sanitizing to the same
             # name (e.g. genserver/weight_version vs the live-state
             # gauge) must not produce a duplicate Prometheus sample.
             continue
-        emit(name, "gauge", v)
+        emit(name, "gauge", v,
+             label_str=_prom_labels(labels, kl) if kl else None)
     for k, v in sorted(snapshot.get("counters", {}).items()):
-        emit(f"{prefix}_{_prom_name(k)}_total", "counter", v)
+        base, kl = _metric_key_labels(k)
+        emit(f"{prefix}_{_prom_name(base)}_total", "counter", v,
+             label_str=_prom_labels(labels, kl) if kl else None)
     for k, h in sorted(snapshot.get("hists", {}).items()):
-        base = f"{prefix}_{_prom_name(k)}"
-        lines.append(f"# TYPE {base} histogram")
+        kbase, kl = _metric_key_labels(k)
+        base = f"{prefix}_{_prom_name(kbase)}"
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} histogram")
+        merged = {**(labels or {}), **(kl or {})}
+        hlab = _prom_labels(merged) if merged else ""
         cum = 0
         for b, c in zip(h["buckets"], h["counts"]):
             cum += c
-            lstr = _prom_labels(labels, {"le": f"{float(b):g}"})
+            lstr = _prom_labels(merged, {"le": f"{float(b):g}"})
             lines.append(f"{base}_bucket{lstr} {cum}")
         cum += h["counts"][-1]
-        lines.append(f"{base}_bucket{_prom_labels(labels, {'le': '+Inf'})} "
+        lines.append(f"{base}_bucket{_prom_labels(merged, {'le': '+Inf'})} "
                      f"{cum}")
-        lines.append(f"{base}_sum{lab} {h['sum']:g}")
-        lines.append(f"{base}_count{lab} {h['count']}")
+        lines.append(f"{base}_sum{hlab} {h['sum']:g}")
+        lines.append(f"{base}_count{hlab} {h['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -993,23 +1024,30 @@ class TelemetryAggregator:
             labels = {"worker_kind": kind, "worker_index": idx}
             lab = _prom_labels(labels)
             for k, v in sorted(st["gauges"].items()):
-                n = f"areal_{_prom_name(k)}"
-                add(n, "gauge", f"{n}{lab} {float(v):g}")
+                kb, kl = _metric_key_labels(k)
+                n = f"areal_{_prom_name(kb)}"
+                ls = _prom_labels(labels, kl) if kl else lab
+                add(n, "gauge", f"{n}{ls} {float(v):g}")
             for k, v in sorted(st["counters"].items()):
-                n = f"areal_{_prom_name(k)}_total"
-                add(n, "counter", f"{n}{lab} {float(v):g}")
+                kb, kl = _metric_key_labels(k)
+                n = f"areal_{_prom_name(kb)}_total"
+                ls = _prom_labels(labels, kl) if kl else lab
+                add(n, "counter", f"{n}{ls} {float(v):g}")
             for k, h in sorted(st["hists"].items()):
-                base = f"areal_{_prom_name(k)}"
+                kb, kl = _metric_key_labels(k)
+                base = f"areal_{_prom_name(kb)}"
+                hlabels = {**labels, **(kl or {})}
+                hlab = _prom_labels(hlabels)
                 cum = 0
                 for b, c in zip(h["buckets"], h["counts"]):
                     cum += c
-                    ls = _prom_labels(labels, {"le": f"{float(b):g}"})
+                    ls = _prom_labels(hlabels, {"le": f"{float(b):g}"})
                     add(base, "histogram", f"{base}_bucket{ls} {cum}")
                 cum += h["counts"][-1]
-                ls = _prom_labels(labels, {"le": "+Inf"})
+                ls = _prom_labels(hlabels, {"le": "+Inf"})
                 add(base, "histogram", f"{base}_bucket{ls} {cum}")
-                add(base, "histogram", f"{base}_sum{lab} {h['sum']:g}")
-                add(base, "histogram", f"{base}_count{lab} {h['count']}")
+                add(base, "histogram", f"{base}_sum{hlab} {h['sum']:g}")
+                add(base, "histogram", f"{base}_count{hlab} {h['count']}")
         if not fams:
             return "# no telemetry received yet\n"
         out: List[str] = []

@@ -65,6 +65,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
                 serving=self.serving,
                 telemetry=self._telemetry(),
+                keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
             )
             for i in range(n_gen)
         ]
@@ -79,6 +80,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             schedule_policy=self.schedule_policy,
             realloc_dir=paths["realloc"],
             telemetry=self._telemetry(),
+            keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
         )
         rollout_workers = [
             RolloutWorkerConfig(

@@ -92,6 +92,11 @@ class GenerationServerConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Liveness lease on the server's gen_servers/ registration
+    # (docs/fault_tolerance.md): a SIGKILLed server's ghost URL expires
+    # from discovery instead of being probed forever. 0 falls back to
+    # the supervisor-set AREAL_WORKER_KEEPALIVE_TTL env.
+    keepalive_ttl_secs: float = 0.0
 
 
 class _Pending:
@@ -845,12 +850,34 @@ class GenerationServer:
         site = web.TCPSite(runner, network.bind_addr(), port)
         await site.start()
         url = f"http://{network.gethostip()}:{port}"
-        name_resolve.add(
-            names.gen_servers(self.cfg.experiment, self.cfg.trial,
-                              self.cfg.server_id),
-            url, replace=True,
+        from areal_tpu.system.worker_base import (
+            HeartbeatThread,
+            env_keepalive_ttl,
         )
-        logger.info(f"generation server {self.cfg.server_id} at {url}")
+
+        ttl = self.cfg.keepalive_ttl_secs or env_keepalive_ttl() or 0.0
+        key = names.gen_servers(self.cfg.experiment, self.cfg.trial,
+                                self.cfg.server_id)
+        name_resolve.add(key, url, replace=True, keepalive_ttl=ttl or None)
+        # Heartbeat from a dedicated THREAD, not this event loop: a long
+        # decode compile blocks the loop for minutes, and the lease must
+        # not lapse (the manager would forget a merely-busy server). The
+        # lease exists for SIGKILLed processes — those lose their
+        # threads too, so the ghost key still expires.
+        self._hb = None
+        if ttl:
+            from areal_tpu.system.worker_base import (
+                default_heartbeat_interval,
+            )
+
+            self._hb = HeartbeatThread(
+                self.cfg.experiment, self.cfg.trial,
+                f"genserver_{self.cfg.server_id}",
+                interval=default_heartbeat_interval(ttl),
+            )
+            self._hb.lease(key, url, ttl)
+        logger.info(f"generation server {self.cfg.server_id} at {url}"
+                    + (f" (keepalive {ttl:.0f}s)" if ttl else ""))
         self._runner_obj = runner
         return url
 
@@ -865,5 +892,7 @@ class GenerationServer:
                 p = self._queue.get_nowait()
                 if not p.future.done():
                     p.future.set_exception(RuntimeError("server aborted"))
+        if getattr(self, "_hb", None) is not None:
+            self._hb.close()
         self.telemetry.close()
         await self._runner_obj.cleanup()

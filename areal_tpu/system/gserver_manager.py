@@ -77,6 +77,13 @@ class GserverManagerConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Liveness lease on the manager's name_resolve registration
+    # (docs/fault_tolerance.md): >0 registers the URL with this
+    # keepalive TTL and heartbeats it from a dedicated thread, so a
+    # SIGKILLed manager's ghost endpoint expires instead of wedging
+    # every client resolve. 0 falls back to the supervisor-set
+    # AREAL_WORKER_KEEPALIVE_TTL env (absent → no lease).
+    keepalive_ttl_secs: float = 0.0
 
 
 @dataclasses.dataclass
@@ -847,11 +854,25 @@ class GserverManager:
         await site.start()
         url = f"http://{network.gethostip()}:{port}"
         self._url = url
-        name_resolve.add(
-            names.gen_server_manager(self.cfg.experiment, self.cfg.trial),
-            url, replace=True,
+        from areal_tpu.system.worker_base import (
+            HeartbeatThread,
+            default_heartbeat_interval,
+            env_keepalive_ttl,
         )
-        logger.info(f"gserver manager at {url}")
+
+        ttl = self.cfg.keepalive_ttl_secs or env_keepalive_ttl() or 0.0
+        key = names.gen_server_manager(self.cfg.experiment, self.cfg.trial)
+        name_resolve.add(key, url, replace=True,
+                         keepalive_ttl=ttl or None)
+        self._hb = None
+        if ttl:
+            self._hb = HeartbeatThread(
+                self.cfg.experiment, self.cfg.trial, "gserver_manager",
+                interval=default_heartbeat_interval(ttl),
+            )
+            self._hb.lease(key, url, ttl)
+        logger.info(f"gserver manager at {url}"
+                    + (f" (keepalive {ttl:.0f}s)" if ttl else ""))
         self._runner_obj = runner
         return url
 
@@ -866,5 +887,7 @@ class GserverManager:
         # destroyed-pending-task noise.
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        if getattr(self, "_hb", None) is not None:
+            self._hb.close()
         self.telemetry.close()
         await self._runner_obj.cleanup()

@@ -113,6 +113,11 @@ class MasterWorker:
         self.ctrl = WorkerControl(
             self.cfg.experiment, self.cfg.trial, "master"
         )
+        # Graceful drain (system/supervisor.py drain_experiment): dump a
+        # recover checkpoint OUT-OF-BAND of the ckpt cadence. Served
+        # between steps (and while paused), so no MFC is in flight when
+        # it runs — the trainer RPC below is safe.
+        self.ctrl.on_command("checkpoint", self._on_demand_ckpt)
         # The aggregator MUST exist before any worker's pusher looks for
         # it, and before the master's own telemetry configures — so it is
         # the first telemetry object up. Disabled config: nothing starts.
@@ -186,11 +191,18 @@ class MasterWorker:
             f"(model versions: {reply.get('versions')})"
         )
 
-    def _do_ckpt(self) -> None:
+    def _on_demand_ckpt(self, payload=None) -> Dict[str, Any]:
+        if not self.cfg.recover_dir:
+            return {"saved": False, "reason": "no recover_dir configured"}
+        ckpt_dir = self._do_ckpt()
+        return {"saved": True, "dir": ckpt_dir, "step": self.step,
+                "epoch": self.epoch}
+
+    def _do_ckpt(self) -> Optional[str]:
         from areal_tpu.base import recover
 
         if not self.cfg.recover_dir:
-            return
+            return None
         name = recover.ckpt_dirname(self.epoch, self.step, self.step)
         ckpt_dir = f"{self.cfg.recover_dir}/{name}"
         self.stream.call(self.cfg.trainer_handler, "ckpt", {"dir": ckpt_dir})
@@ -217,6 +229,7 @@ class MasterWorker:
                 entries.append((st.global_step, n))
         for _, n in sorted(entries)[: -self.cfg.keep_recover_ckpts]:
             shutil.rmtree(f"{self.cfg.recover_dir}/{n}", ignore_errors=True)
+        return ckpt_dir
 
     def _count_mfc_flops(self, node: MFCDef, metas: List[SequenceSample]) -> None:
         """Analytic FLOPs for one MFC from input metadata (lengths only)."""
@@ -398,6 +411,22 @@ class MasterWorker:
             )
         total = time.monotonic() - t_start
         logger.info(f"experiment complete: {self.step} steps in {total:.1f}s")
+        # Published BEFORE the trainer is told to exit: the launcher's
+        # supervisor consults this (timestamped) marker when it sees a
+        # child die, so the commanded end-of-run trainer exit is never
+        # classified as a stateful-worker death and escalated while this
+        # thread is still in its teardown tail.
+        try:
+            import json as _json
+
+            from areal_tpu.base import name_resolve, names
+            name_resolve.add(
+                names.experiment_status(self.cfg.experiment, self.cfg.trial),
+                _json.dumps({"status": "finishing", "ts": time.time()}),
+                replace=True, delete_on_exit=False,
+            )
+        except Exception:  # noqa: BLE001 — marker is advisory
+            pass
         await asyncio.to_thread(
             self.stream.call, self.cfg.trainer_handler, "exit"
         )

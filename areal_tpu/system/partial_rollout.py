@@ -66,11 +66,17 @@ class PartialRolloutClient:
                  retry: Optional[RetryPolicy] = None,
                  fault_injector: Optional[FaultInjector] = None,
                  no_server_wait_secs: float = 180.0,
-                 request_class: str = "rollout"):
+                 request_class: str = "rollout",
+                 manager_resolver=None):
         self.manager_url = manager_url
         self.session = session  # aiohttp.ClientSession
         self.chunk_tokens = chunk_tokens
         self.retry = retry or DEFAULT_GENERATION_RETRY
+        # Optional () -> url callable re-resolving the manager's CURRENT
+        # endpoint (name_resolve): a supervised gen-fleet respawn binds a
+        # fresh port, and scheduling must follow it there instead of
+        # hammering the dead incarnation's socket.
+        self._manager_resolver = manager_resolver
         # Serving-engine request class (docs/serving.md): tags the
         # manager's lease and the server's admission/priority/SLO
         # decisions. "interactive"/"eval" clients share the fleet with
@@ -88,14 +94,38 @@ class PartialRolloutClient:
         self.n_failovers = 0
         self.n_abandoned = 0
 
+    def _refresh_manager_url(self) -> None:
+        if self._manager_resolver is None:
+            return
+        try:
+            url = self._manager_resolver()
+        except Exception:  # noqa: BLE001 — key cleared mid-respawn
+            return
+        if url and url != self.manager_url:
+            logger.warning(f"manager endpoint moved "
+                           f"{self.manager_url} -> {url}; re-routing")
+            self.manager_url = url
+
     async def _schedule(self) -> Dict:
         if self.faults is not None:
             self.faults.maybe_fail("schedule")
-        async with self.session.post(
-            f"{self.manager_url}/schedule_request",
-            json={"class": self.request_class},
-        ) as r:
-            d = await r.json()
+        try:
+            async with self.session.post(
+                f"{self.manager_url}/schedule_request",
+                json={"class": self.request_class},
+            ) as r:
+                d = await r.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — manager itself is down
+            # An unreachable MANAGER is fleet-empty from this client's
+            # perspective: burn the (long) no-server wait budget, not the
+            # millisecond chunk-failover attempts, and chase the
+            # re-registered endpoint before the next poll.
+            self._refresh_manager_url()
+            raise NoHealthyServersError(
+                f"manager unreachable: {e}"
+            ) from None
         if not d.get("url"):
             raise NoHealthyServersError(d.get("reason", "unknown"))
         return d

@@ -80,7 +80,17 @@ class RolloutWorkerConfig:
 
 class ConsumedLog:
     """Append-only consumed-uid log for async recovery. One file per
-    rollout worker; crash-safe because lines are tiny appends."""
+    rollout worker.
+
+    Durability is the whole point of this file — a record that did not
+    reach disk before a crash means a recovered run RE-TRAINS that
+    prompt, the exact bug the log exists to prevent. So every append is
+    flushed AND fsynced before ``add`` returns (records are tiny; the
+    fsync is amortized by the network round-trips that precede it), and
+    the reader tolerates a torn tail: a final line without its
+    terminating newline is a record whose write was cut mid-append — it
+    never fully landed, so it is dropped (that prompt re-trains once,
+    which is the safe direction)."""
 
     def __init__(self, recover_dir: str, worker_index: int):
         self.path = (
@@ -88,9 +98,25 @@ class ConsumedLog:
             if recover_dir else None
         )
         self.seen = set()
+        self._fh = None
         if self.path and os.path.exists(self.path):
-            with open(self.path) as f:
-                self.seen = {ln.strip() for ln in f if ln.strip()}
+            with open(self.path, "rb") as f:
+                raw = f.read()
+            data = raw.decode(errors="replace")
+            lines = data.split("\n")
+            if data and not data.endswith("\n"):
+                torn = lines.pop()
+                logger.warning(
+                    f"consumed log {self.path}: dropping torn tail "
+                    f"{torn[:64]!r} (crash mid-append); the prompt will "
+                    f"be re-trained"
+                )
+                # Repair in place: truncating the fragment keeps later
+                # appends from merging into it (which would corrupt the
+                # NEXT record too).
+                with open(self.path, "rb+") as f:
+                    f.truncate(raw.rfind(b"\n") + 1)
+            self.seen = {ln.strip() for ln in lines if ln.strip()}
 
     def __contains__(self, uid: str) -> bool:
         return uid in self.seen
@@ -100,9 +126,17 @@ class ConsumedLog:
             return
         self.seen.add(uid)
         if self.path:
-            os.makedirs(os.path.dirname(self.path), exist_ok=True)
-            with open(self.path, "a") as f:
-                f.write(uid + "\n")
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(uid + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 class RolloutWorker:
@@ -122,6 +156,7 @@ class RolloutWorker:
 
         self.env = MathCodeSingleStepEnv(self.id2info)
         self.consumed = ConsumedLog(cfg.recover_dir, cfg.worker_index)
+        self._mgr_url0 = ""  # pre-client bootstrap; see _mgr_url property
         self._done = 0
         self._pushed = 0
         self._abandoned = 0
@@ -154,7 +189,25 @@ class RolloutWorker:
         ) as r:
             return await r.json()
 
-    async def _rollout_one(self, rec, uid, client, pusher, mgr_url, session):
+    @property
+    def _mgr_url(self) -> str:
+        """The manager's endpoint — owned by the PartialRolloutClient
+        once it exists (ONE source of truth: the client's resolver and
+        this worker's quota RPCs must never diverge onto different
+        incarnations of a respawned manager)."""
+        client = getattr(self, "client", None)
+        return client.manager_url if client is not None else self._mgr_url0
+
+    def _refresh_mgr_url(self) -> None:
+        """Re-resolve the gserver manager's endpoint: a supervised
+        gen-fleet respawn binds a fresh port and re-registers under the
+        same name_resolve key — the worker must follow it there instead
+        of retrying the dead incarnation's socket forever."""
+        client = getattr(self, "client", None)
+        if client is not None:
+            client._refresh_manager_url()
+
+    async def _rollout_one(self, rec, uid, client, pusher, session):
         cfg = self.cfg
         # quota / staleness gate — allocate in SAMPLE units: one prompt
         # produces group_size samples, and the manager's is_staled /
@@ -167,7 +220,7 @@ class RolloutWorker:
         # the RPC, and on cancellation let it complete and compensate.
         t_alloc = time.monotonic()
         alloc_fut = asyncio.ensure_future(self._post_json(
-            session, f"{mgr_url}/allocate_rollout",
+            session, f"{self._mgr_url}/allocate_rollout",
             {"n_samples": cfg.group_size},
         ))
         try:
@@ -180,7 +233,7 @@ class RolloutWorker:
             if alloc is not None and alloc.get("allowed"):
                 try:
                     await self._post_json(
-                        session, f"{mgr_url}/finish_rollout",
+                        session, f"{self._mgr_url}/finish_rollout",
                         {"accepted": False, "n_samples": cfg.group_size,
                          "n_accepted": 0},
                     )
@@ -194,8 +247,11 @@ class RolloutWorker:
         except Exception as e:  # noqa: BLE001 — manager blip: not fatal
             # A failed allocation made no booking — retry later instead of
             # letting the error reach d.result() and kill the worker (the
-            # same survival contract the /generate chunks have).
+            # same survival contract the /generate chunks have). The
+            # manager may have been respawned at a new port: re-resolve
+            # before the retry.
             logger.warning(f"allocate_rollout failed ({e}); retrying")
+            self._refresh_mgr_url()
             await asyncio.sleep(1.0)
             return "retry"
         if not alloc.get("allowed"):
@@ -286,7 +342,7 @@ class RolloutWorker:
                     await asyncio.gather(task, return_exceptions=True)
                 try:
                     await self._post_json(
-                        session, f"{mgr_url}/finish_rollout",
+                        session, f"{self._mgr_url}/finish_rollout",
                         {"accepted": accepted > 0,
                          "n_samples": cfg.group_size,
                          "n_accepted": accepted},
@@ -325,14 +381,20 @@ class RolloutWorker:
         ctrl = WorkerControl(
             cfg.experiment, cfg.trial, f"rollout{cfg.worker_index}"
         )
-        mgr_url = name_resolve.wait(
+        self._mgr_url0 = name_resolve.wait(
             names.gen_server_manager(cfg.experiment, cfg.trial), timeout=300
         )
         pusher = ZmqPusher(cfg.experiment, cfg.trial, cfg.trainer_handler)
         async with aiohttp.ClientSession() as session:
             client = PartialRolloutClient(
-                mgr_url, session, chunk_tokens=cfg.chunk_tokens,
+                self._mgr_url, session, chunk_tokens=cfg.chunk_tokens,
                 retry=cfg.retry, fault_injector=self.faults,
+                # A respawned manager registers a fresh URL under the
+                # same key; the client re-resolves on manager-connection
+                # failures instead of wedging on the dead socket.
+                manager_resolver=lambda: name_resolve.get(
+                    names.gen_server_manager(cfg.experiment, cfg.trial)
+                ),
             )
             self.client = client  # exposed for tests/telemetry
             sem = asyncio.Semaphore(cfg.max_concurrent)
@@ -356,7 +418,7 @@ class RolloutWorker:
                         while True:
                             t_attempt = time.monotonic()
                             status = await self._rollout_one(
-                                rec, uid, client, pusher, mgr_url, session
+                                rec, uid, client, pusher, session
                             )
                             if status != "retry":
                                 break
@@ -416,6 +478,7 @@ class RolloutWorker:
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
         ctrl.close()
+        self.consumed.close()
         telemetry.shutdown()  # final flush to the aggregator
         logger.info(
             f"rollout worker done: {self._pushed} trajectories pushed "

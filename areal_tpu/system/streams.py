@@ -140,16 +140,25 @@ class MasterRequestStream:
 
 
 class WorkerRequestServer:
-    """Worker-side ROUTER bound on a free port, registered in name_resolve."""
+    """Worker-side ROUTER bound on a free port, registered in name_resolve.
+
+    Under a supervisor the advertisement carries a liveness lease
+    (AREAL_WORKER_KEEPALIVE_TTL): the owning worker must keep it alive
+    via its control heartbeat (``WorkerControl.lease(server._key)``) so a
+    SIGKILLed worker's stale address expires instead of silently
+    swallowing every request a recovered master sends it."""
 
     def __init__(self, experiment: str, trial: str, handler: str):
+        from areal_tpu.system.worker_base import env_keepalive_ttl
+
         self.handler = handler
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
         self._key = req_reply_addr_key(experiment, trial, handler)
-        name_resolve.add(self._key, network.advertised_tcp(port),
-                         replace=True)
+        self._addr = network.advertised_tcp(port)
+        name_resolve.add(self._key, self._addr,
+                         replace=True, keepalive_ttl=env_keepalive_ttl())
         self._peer_of: Dict[str, bytes] = {}
 
     def poll(self, timeout_ms: int = 0) -> Optional[Payload]:
@@ -211,13 +220,17 @@ def _unpack(raw: bytes) -> Any:
 class ZmqPuller:
     def __init__(self, experiment: str, trial: str, name: str,
                  capacity: int = 16384):
+        from areal_tpu.system.worker_base import env_keepalive_ttl
+
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.PULL)
         self._sock.setsockopt(zmq.RCVHWM, capacity)
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
+        self._key = push_pull_addr_key(experiment, trial, name)
+        self._addr = network.advertised_tcp(port)
         name_resolve.add(
-            push_pull_addr_key(experiment, trial, name),
-            network.advertised_tcp(port), replace=True,
+            self._key, self._addr, replace=True,
+            keepalive_ttl=env_keepalive_ttl(),
         )
 
     def pull(self, timeout_ms: int = 0) -> Optional[Any]:
@@ -226,6 +239,15 @@ class ZmqPuller:
         return _unpack(self._sock.recv())
 
     def close(self):
+        # Withdraw the advertisement (same contract as
+        # WorkerRequestServer.close): a drained run's successor resolves
+        # this key within seconds — a pusher that binds the dead address
+        # sends every trajectory into the void, starving the new master
+        # until the staleness gate wedges the whole resume.
+        try:
+            name_resolve.delete(self._key)
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
         self._sock.close(linger=0)
 
 

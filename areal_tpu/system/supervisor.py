@@ -1,0 +1,560 @@
+"""Launcher-level worker supervision: per-kind restart policy, crash-loop
+circuit breaker, liveness accounting, and graceful preemption drain.
+
+Parity target: ``realhf/apps/main.py:118-180`` (the reference's
+launcher-level restart loop) + ``worker_base.py`` lifecycle control —
+except the reference relaunches the WHOLE experiment on any worker death.
+Here death is classified by failure domain first:
+
+ - **Stateless domain** (rollout workers, the gen-fleet process): all
+   durable state lives elsewhere (ConsumedLog on disk, weights at the
+   trainer, quota reconstructable by the manager). These are respawned IN
+   PLACE with exponential backoff; the respawn rejoins through
+   name_resolve and the gserver manager's existing health-gate /
+   re-admission / weight-reconcile machinery. A crash loop (more than
+   ``RestartPolicy.max_restarts`` inside the rolling window) opens the
+   circuit breaker and escalates.
+ - **Stateful domain** (trainer, master): optimizer state and the step
+   counter live there; an in-place respawn cannot rejoin a running step.
+   Death escalates as :class:`SupervisorEscalation`, which
+   ``run_experiment``'s ``recover_mode=auto`` loop converts into a
+   whole-experiment relaunch from the last recover checkpoint.
+
+An **unexpected clean exit** (exit code 0 of a required worker that was
+never asked to exit) is a failure too: a rollout worker silently exiting
+early would otherwise leave the master blocked on data-wait forever.
+
+Liveness is grounded in name-resolve keepalive leases
+(``name_resolve.add(..., keepalive_ttl=...)`` + ``touch``): the
+supervisor stamps every child with an incarnation id and a TTL via the
+environment (system/worker_base.py reads both), workers heartbeat their
+advertisements from a dedicated thread, and before a respawn the
+supervisor clears the dead incarnation's ghost keys so the control
+panel, the manager, and the streams never address a corpse.
+
+Restart counts, crash-loop state, heartbeat ages, and the drain phase
+are exported through the PR 4 telemetry registry
+(``supervisor_restarts_total{worker_kind=...}`` etc. on the merged
+Prometheus scrape), and an escalation dumps the flight-recorder ring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import multiprocessing as mp
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.base import logging, name_resolve, names, telemetry
+
+logger = logging.getLogger("system.supervisor")
+
+# Failure domains (docs/fault_tolerance.md §Failure domains).
+STATELESS_KINDS = ("rollout", "gen_fleet")
+
+
+class SupervisorEscalation(RuntimeError):
+    """A death the supervisor cannot absorb: stateful-domain worker died,
+    or a stateless worker crash-looped past the circuit breaker. The
+    launcher lets this propagate so ``run_experiment``'s recover loop
+    relaunches the whole experiment."""
+
+
+@dataclasses.dataclass
+class RestartPolicy:
+    """Per-worker respawn policy for the stateless domain."""
+
+    max_restarts: int = 3  # per rolling window, then escalate
+    window_secs: float = 300.0
+    backoff_base_secs: float = 0.5
+    backoff_max_secs: float = 30.0
+    backoff_multiplier: float = 2.0
+
+    def backoff(self, n_recent_restarts: int) -> float:
+        return min(
+            self.backoff_base_secs
+            * self.backoff_multiplier ** max(n_recent_restarts - 1, 0),
+            self.backoff_max_secs,
+        )
+
+    @classmethod
+    def from_config(cls, ft) -> "RestartPolicy":
+        """Build from an api.train_config.FaultToleranceConfig-shaped
+        object (getattr-based: plain test configs work too)."""
+        return cls(
+            max_restarts=getattr(ft, "max_restarts", 3),
+            window_secs=getattr(ft, "restart_window_secs", 300.0),
+            backoff_base_secs=getattr(ft, "backoff_base_secs", 0.5),
+            backoff_max_secs=getattr(ft, "backoff_max_secs", 30.0),
+            backoff_multiplier=getattr(ft, "backoff_multiplier", 2.0),
+        )
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """One supervised child process."""
+
+    name: str  # worker-control name ("rollout0", "gen_fleet", "trainer0")
+    kind: str  # failure-domain key ("rollout" | "gen_fleet" | "trainer")
+    target: Callable  # module-level fn (mp spawn pickles it)
+    args: Tuple = ()
+    # A required worker exiting 0 without an exit request is a failure
+    # (the master would block on data-wait forever, not crash).
+    required: bool = True
+
+
+class _Entry:
+    __slots__ = ("spec", "proc", "incarnation", "restarts", "respawn_due",
+                 "done")
+
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self.proc = None
+        self.incarnation = 0
+        self.restarts: List[float] = []  # clock() stamps, pruned to window
+        self.respawn_due: Optional[float] = None
+        self.done = False  # death already handled / expected
+
+
+class Supervisor:
+    """Spawn + monitor the launcher's child workers.
+
+    ``check()`` is called from the launcher's monitor loop (~1 Hz). It
+    never sleeps: respawns are *scheduled* (``respawn_due``) and executed
+    on the first check() past their backoff — so tests drive the whole
+    state machine with an injected clock and fake processes.
+    """
+
+    def __init__(self, experiment: str, trial: str,
+                 policy: Optional[RestartPolicy] = None,
+                 keepalive_ttl: float = 0.0,
+                 heartbeat_interval: float = 0.0,
+                 restartable_kinds: Tuple[str, ...] = STATELESS_KINDS,
+                 clock: Callable[[], float] = time.monotonic):
+        self.experiment = experiment
+        self.trial = trial
+        self.policy = policy or RestartPolicy()
+        self.keepalive_ttl = keepalive_ttl
+        self.heartbeat_interval = heartbeat_interval
+        self.restartable_kinds = tuple(restartable_kinds)
+        self.clock = clock
+        self._entries: Dict[str, _Entry] = {}
+        self._draining = False
+        self.restart_counts: Dict[str, int] = {}  # kind -> total respawns
+        self._last_hb_export = 0.0
+        # Wall-clock birth: shutdown markers (experiment finishing, drain
+        # phases) older than this belong to a PREVIOUS incarnation of the
+        # trial and must not suppress real failure detection.
+        self._t_start_wall = time.time()
+
+    # ---------------- spawning ----------------
+
+    def spawn(self, spec: WorkerSpec) -> None:
+        entry = _Entry(spec)
+        self._entries[spec.name] = entry
+        self._start(entry)
+
+    def _start(self, entry: _Entry) -> None:
+        entry.incarnation += 1
+        entry.done = False
+        entry.proc = self._make_proc(entry.spec, entry.incarnation)
+        logger.info(
+            f"spawned {entry.spec.name} (kind={entry.spec.kind}, "
+            f"incarnation {entry.incarnation}, pid {entry.proc.pid})"
+        )
+
+    def _make_proc(self, spec: WorkerSpec, incarnation: int):
+        """Start the actual OS process (tests override this with fakes).
+        The incarnation id and keepalive TTL travel via the environment —
+        mp's spawn snapshot picks them up before the child imports
+        anything (system/worker_base.py reads them back)."""
+        from areal_tpu.system import worker_base as wb
+
+        ctx = mp.get_context("spawn")
+        saved = {
+            k: os.environ.get(k)
+            for k in (wb.ENV_INCARNATION, wb.ENV_KEEPALIVE_TTL,
+                      wb.ENV_HEARTBEAT_INTERVAL)
+        }
+        os.environ[wb.ENV_INCARNATION] = str(incarnation)
+        if self.keepalive_ttl > 0:
+            os.environ[wb.ENV_KEEPALIVE_TTL] = repr(self.keepalive_ttl)
+        if self.heartbeat_interval > 0:
+            os.environ[wb.ENV_HEARTBEAT_INTERVAL] = repr(
+                self.heartbeat_interval
+            )
+        try:
+            p = ctx.Process(target=spec.target, args=spec.args,
+                            daemon=True, name=spec.name)
+            p.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return p
+
+    # ---------------- monitoring ----------------
+
+    def procs(self) -> List:
+        return [e.proc for e in self._entries.values()
+                if e.proc is not None]
+
+    def begin_drain(self) -> None:
+        """Planned teardown from here on: child exits (any code) are
+        expected and never restarted or escalated."""
+        self._draining = True
+        for e in self._entries.values():
+            e.respawn_due = None
+        telemetry.set_gauge("supervisor/draining", 1.0)
+
+    def check(self) -> None:
+        """One supervision sweep: execute due respawns, classify new
+        deaths, export heartbeat ages. Raises SupervisorEscalation for
+        the stateful domain and for tripped circuit breakers."""
+        now = self.clock()
+        for entry in self._entries.values():
+            if entry.respawn_due is not None:
+                if now >= entry.respawn_due and not self._draining:
+                    self._respawn(entry)
+                continue
+            p = entry.proc
+            if p is None or entry.done or p.is_alive():
+                continue
+            code = p.exitcode
+            if self._draining or (code == 0 and not entry.spec.required):
+                entry.done = True
+                continue
+            if self._shutdown_signaled():
+                # A commanded teardown is in progress that this process
+                # didn't initiate: the master published its end-of-run
+                # marker (its thread is still in the teardown tail when
+                # the trainer's commanded exit lands here), or an
+                # external `perf_probe drain` is walking the workers
+                # down. Expected deaths — supervising them would
+                # escalate a SUCCESSFUL run as a failure.
+                logger.info(
+                    f"{entry.spec.name} exited during a signaled "
+                    f"shutdown/drain; treating as expected"
+                )
+                self.begin_drain()
+                entry.done = True
+                continue
+            self._on_death(entry, code, now)
+        self._export_heartbeats(now)
+
+    def _shutdown_signaled(self) -> bool:
+        """True iff a commanded teardown newer than this supervisor is
+        advertised in name_resolve: the master's end-of-run marker
+        (``experiment_status`` = finishing) or a graceful-drain phase
+        written by ``drain_experiment`` — possibly driven EXTERNALLY
+        (``perf_probe drain``), which this process otherwise cannot see.
+        Consulted only when classifying an observed death (no
+        steady-state polling cost); stale markers from a previous
+        incarnation of the trial are ignored by timestamp."""
+        for key in (
+            names.experiment_status(self.experiment, self.trial),
+            names.drain_status(self.experiment, self.trial),
+        ):
+            try:
+                d = json.loads(name_resolve.get(key))
+                if float(d.get("ts", 0.0)) >= self._t_start_wall:
+                    return True
+            except Exception:  # noqa: BLE001 — absent / torn: no signal
+                pass
+        return False
+
+    def _on_death(self, entry: _Entry, code, now: float) -> None:
+        spec = entry.spec
+        reason = ("unexpected clean exit (exit 0 without an exit request)"
+                  if code == 0 else f"exit code {code}")
+        telemetry.inc(f"supervisor/deaths{{worker_kind={spec.kind}}}")
+        if spec.kind not in self.restartable_kinds:
+            self._escalate(
+                entry, f"stateful worker {spec.name} died: {reason}; "
+                f"escalating to whole-experiment recovery"
+            )
+        entry.restarts = [
+            t for t in entry.restarts if now - t < self.policy.window_secs
+        ]
+        if len(entry.restarts) >= self.policy.max_restarts:
+            telemetry.set_gauge(
+                f"supervisor/crash_loop_open{{worker_kind={spec.kind}}}",
+                1.0,
+            )
+            self._escalate(
+                entry, f"{spec.name} crash-looped: "
+                f"{len(entry.restarts)} restarts inside "
+                f"{self.policy.window_secs:.0f}s (last death: {reason}); "
+                f"circuit breaker open"
+            )
+        entry.restarts.append(now)
+        backoff = self.policy.backoff(len(entry.restarts))
+        entry.respawn_due = now + backoff
+        logger.warning(
+            f"{spec.name} (kind={spec.kind}) died: {reason}; respawning "
+            f"in {backoff:.2f}s "
+            f"({len(entry.restarts)}/{self.policy.max_restarts} restarts "
+            f"in window)"
+        )
+
+    def _escalate(self, entry: _Entry, msg: str) -> None:
+        entry.done = True
+        logger.error(msg)
+        # Post-mortem evidence before the teardown: the launcher-process
+        # flight ring (master spans, supervisor events) dumps now; the
+        # per-worker SIGTERM hooks dump the survivors during shutdown.
+        t = telemetry.get()
+        if t.enabled:
+            t.event("supervisor/escalate", worker=entry.spec.name,
+                    kind=entry.spec.kind, reason=msg)
+            t.flight_dump(reason=f"supervisor escalation: {msg}")
+        raise SupervisorEscalation(msg)
+
+    def _respawn(self, entry: _Entry) -> None:
+        entry.respawn_due = None
+        spec = entry.spec
+        self._clear_ghost_keys(spec)
+        self.restart_counts[spec.kind] = (
+            self.restart_counts.get(spec.kind, 0) + 1
+        )
+        telemetry.inc(f"supervisor/restarts{{worker_kind={spec.kind}}}")
+        self._start(entry)
+        logger.warning(
+            f"respawned {spec.name} (incarnation {entry.incarnation}); it "
+            f"rejoins through name_resolve"
+        )
+
+    def _clear_ghost_keys(self, spec: WorkerSpec) -> None:
+        """Delete the dead incarnation's registrations BEFORE the respawn
+        binds fresh ones, so nothing resolves a corpse in the gap. The
+        respawn re-adds its own keys with replace=True anyway; this
+        closes the window for keys the new incarnation takes a while to
+        re-register (the manager URL while servers re-prefill, say)."""
+        from areal_tpu.system.worker_base import worker_control_key
+
+        doomed = [
+            worker_control_key(self.experiment, self.trial, spec.name),
+            names.worker_heartbeat(self.experiment, self.trial, spec.name),
+        ]
+        if spec.kind == "gen_fleet":
+            # The fleet process hosts the servers AND the manager: clear
+            # their discovery keys so rollout clients fail fast and
+            # re-resolve instead of retrying dead sockets.
+            try:
+                name_resolve.clear_subtree(names.gen_server_root(
+                    self.experiment, self.trial
+                ))
+            except Exception:  # noqa: BLE001
+                pass
+            doomed.append(names.gen_server_manager(
+                self.experiment, self.trial
+            ))
+            hb_root = names.worker_heartbeat_root(self.experiment,
+                                                  self.trial)
+            for key in self._safe_find(hb_root):
+                worker = key[len(hb_root.rstrip("/")) + 1:]
+                if worker.startswith(("genserver_", "gserver_manager")):
+                    doomed.append(key)
+        for key in doomed:
+            try:
+                name_resolve.delete(key)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+
+    @staticmethod
+    def _safe_find(root: str) -> List[str]:
+        try:
+            return name_resolve.find_subtree(root)
+        except Exception:  # noqa: BLE001
+            return []
+
+    def _export_heartbeats(self, now: float) -> None:
+        """Heartbeat-age gauges for the merged scrape (rate-limited: the
+        NFS walk is a directory scan). Ages come from the heartbeat keys
+        workers rewrite; a worker whose process is alive but whose
+        heartbeat is stale is wedged, which process liveness can't see."""
+        if not telemetry.enabled() or self.keepalive_ttl <= 0:
+            return
+        if now - self._last_hb_export < max(self.keepalive_ttl / 3, 1.0):
+            return
+        self._last_hb_export = now
+        from areal_tpu.system.worker_base import read_heartbeats
+
+        try:
+            hbs = read_heartbeats(self.experiment, self.trial)
+        except Exception:  # noqa: BLE001 — name-resolve hiccup
+            return
+        for worker, d in hbs.items():
+            age = d.get("age_secs")
+            if age is None:
+                continue
+            telemetry.set_gauge(
+                f"supervisor/heartbeat_age_secs{{worker={worker}}}", age
+            )
+            if age > 3 * self.keepalive_ttl:
+                logger.warning(
+                    f"heartbeat of {worker} is {age:.0f}s old "
+                    f"(ttl {self.keepalive_ttl:.0f}s) — wedged worker?"
+                )
+
+    # ---------------- teardown ----------------
+
+    def shutdown(self, timeout: float = 10.0, orderly: bool = True) -> None:
+        """First-line teardown is ORDERLY: ask workers with a control
+        endpoint to exit (they drain in-flight work and report their
+        quota), then terminate/kill whatever remains. ``orderly=False``
+        skips straight to terminate (tests, escalation paths)."""
+        self.begin_drain()
+        asked: List = []  # procs we asked to exit: they earn a grace join
+        if orderly:
+            try:
+                from areal_tpu.system.worker_base import WorkerControlPanel
+
+                panel = None
+                for entry in self._entries.values():
+                    if (entry.proc is not None and entry.proc.is_alive()
+                            and entry.spec.kind == "rollout"):
+                        if panel is None:
+                            panel = WorkerControlPanel(
+                                self.experiment, self.trial, timeout=2.0
+                            )
+                        res = panel.try_command(entry.spec.name, "exit")
+                        if res.get("ok"):
+                            asked.append(entry.proc)
+                if panel is not None:
+                    panel.close()
+            except Exception:  # noqa: BLE001 — fall back to terminate
+                pass
+        deadline = time.monotonic() + timeout / 2
+        for p in asked:
+            p.join(timeout=max(0.05, deadline - time.monotonic()))
+        procs = self.procs()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + timeout / 2
+        for p in procs:
+            p.join(timeout=max(0.05, deadline - time.monotonic()))
+            if p.is_alive():
+                p.kill()
+
+
+# --------------------------------------------------------------------------
+# graceful drain (SIGTERM / preemption path)
+# --------------------------------------------------------------------------
+
+
+def _set_drain_phase(experiment: str, trial: str, phase: str) -> None:
+    try:
+        name_resolve.add(
+            names.drain_status(experiment, trial),
+            json.dumps({"phase": phase, "ts": time.time()}),
+            replace=True, delete_on_exit=False,
+        )
+    except Exception:  # noqa: BLE001 — status is advisory
+        pass
+    telemetry.event("supervisor/drain_phase", phase=phase)
+
+
+def drain_experiment(experiment: str, trial: str,
+                     timeout: float = 60.0, panel=None) -> Dict:
+    """Preemption-aware graceful drain of a live experiment
+    (docs/operations.md §Preemption drain):
+
+      1. PAUSE the master FIRST — the pause lands at a step boundary
+         (retried while it is busy inside a step; the in-flight step
+         still has live data producers), after which it serves further
+         control commands from inside its paused loop and, crucially,
+         never STARTS another step. Ordering matters: pausing the data
+         producers first would starve a mid-step master that then never
+         reaches its control channel — a drain deadlock.
+      2. PAUSE every rollout worker — no new rollouts are issued;
+         in-flight ones keep running on the workers' event loops and
+         complete (pause only blocks the scheduling loop).
+      3. Out-of-band recover CHECKPOINT via the master's ``checkpoint``
+         control command, served while paused. No MFC is in flight
+         (the master is parked between steps), so the trainer RPC is
+         safe — and the trainer is deliberately never paused: it has to
+         serve this checkpoint and the master's final exit RPC.
+      4. Orderly EXIT: the master first (exit overrides pause; it breaks
+         out of its loop WITHOUT executing another step, then its normal
+         finalization tells the trainer to exit and closes the
+         aggregator), then the rollout workers — whose shutdown path
+         cancels stragglers and reports ``/finish_rollout``.
+
+    The gen-fleet process has no control endpoint; the launcher
+    terminates it after the master returns (it holds no durable state).
+    Works against any live run via name_resolve — the launcher's SIGTERM
+    handler and ``tools/perf_probe.py drain`` both call this.
+    """
+    from areal_tpu.system.worker_base import WorkerControlPanel
+
+    own_panel = panel is None
+    if panel is None:
+        panel = WorkerControlPanel(experiment, trial,
+                                   timeout=min(timeout / 4, 15.0))
+    report: Dict = {"paused": {}, "checkpoint": None, "exited": []}
+    deadline = time.monotonic() + timeout
+
+    def _retry_command(worker: str, cmd: str) -> Dict:
+        """Retry an IDEMPOTENT command while the worker is busy inside a
+        step (its control channel is only served between iterations)."""
+        while True:
+            try:
+                return panel.command(worker, cmd)
+            except TimeoutError as e:
+                if time.monotonic() >= deadline:
+                    return {"ok": False, "error": str(e)}
+
+    try:
+        workers = panel.list_workers()
+        rollouts = [w for w in workers if w.startswith("rollout")]
+        _set_drain_phase(experiment, trial, "pausing")
+        if "master" in workers:
+            report["paused"]["master"] = _retry_command("master", "pause")
+        for w in rollouts:
+            report["paused"][w] = panel.try_command(w, "pause")
+        if "master" in workers:
+            _set_drain_phase(experiment, trial, "checkpoint")
+            # Checkpoint is NOT idempotent-cheap: a retry-on-timeout
+            # would queue redundant full checkpoints behind a slow one
+            # and report failure while they all succeed. The master is
+            # already paused (its control loop serves continuously), so
+            # the only latency is the checkpoint itself: send ONCE on a
+            # dedicated panel whose receive window is the remaining
+            # drain budget.
+            ck_panel = WorkerControlPanel(
+                experiment, trial,
+                timeout=max(deadline - time.monotonic(), 1.0),
+            )
+            try:
+                report["checkpoint"] = ck_panel.command(
+                    "master", "checkpoint"
+                )
+            except TimeoutError as e:
+                report["checkpoint"] = {
+                    "ok": False,
+                    "error": f"{e} (checkpoint may still be running; "
+                             f"NOT re-sent — it is not idempotent-cheap)",
+                }
+            finally:
+                ck_panel.close()
+        _set_drain_phase(experiment, trial, "exiting")
+        if "master" in workers:
+            res = panel.try_command("master", "exit")
+            if res.get("ok"):
+                report["exited"].append("master")
+        for w in rollouts:
+            res = panel.try_command(w, "exit")
+            if res.get("ok"):
+                report["exited"].append(w)
+        _set_drain_phase(experiment, trial, "done")
+    finally:
+        if own_panel:
+            panel.close()
+    logger.info(f"graceful drain complete: {report}")
+    return report

@@ -747,6 +747,15 @@ class TrainerWorker:
             ctrl = WorkerControl(
                 self.cfg.experiment, self.cfg.trial, self.cfg.handler
             )
+            # Liveness: the control heartbeat also keeps the trainer's
+            # stream advertisements leased (request ROUTER + trajectory
+            # puller) — a SIGKILLed trainer's stale addresses expire
+            # instead of swallowing a recovered master's requests; the
+            # value rides along so a lapsed lease re-registers.
+            if self._server is not None:
+                ctrl.lease(self._server._key, self._server._addr)
+            if self._puller is not None:
+                ctrl.lease(self._puller._key, self._puller._addr)
             while not self._exiting:
                 ctrl.step(lambda: {"roles": sorted(self.models)})
                 if ctrl.should_exit:

@@ -18,16 +18,79 @@ during experiment reconfiguration.
 
 from __future__ import annotations
 
+import json
+import os
 import pickle
+import threading
 import time
 from enum import Enum
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import zmq
 
 from areal_tpu.base import logging, name_resolve, names, network
 
 logger = logging.getLogger("system.worker_base")
+
+# Set by the supervisor (system/supervisor.py) on every spawned child:
+# the incarnation id distinguishes a respawned worker's registrations
+# from its dead predecessor's ghosts, and the keepalive TTL puts a
+# liveness lease on its name-resolve advertisements.
+ENV_INCARNATION = "AREAL_WORKER_INCARNATION"
+ENV_KEEPALIVE_TTL = "AREAL_WORKER_KEEPALIVE_TTL"
+ENV_HEARTBEAT_INTERVAL = "AREAL_WORKER_HEARTBEAT_INTERVAL"
+
+
+def env_incarnation() -> int:
+    try:
+        return int(os.environ.get(ENV_INCARNATION, "0"))
+    except ValueError:
+        return 0
+
+
+def _env_positive_float(name: str) -> Optional[float]:
+    try:
+        v = float(os.environ.get(name, "0"))
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def env_keepalive_ttl() -> Optional[float]:
+    return _env_positive_float(ENV_KEEPALIVE_TTL)
+
+
+def env_heartbeat_interval() -> Optional[float]:
+    return _env_positive_float(ENV_HEARTBEAT_INTERVAL)
+
+
+def default_heartbeat_interval(ttl: float) -> float:
+    """The heartbeat cadence for a lease of ``ttl`` seconds: explicit
+    operator override (fault_tolerance.heartbeat_interval_secs via the
+    supervisor's env stamp) or ttl/3."""
+    return env_heartbeat_interval() or ttl / 3.0
+
+
+def read_heartbeats(experiment: str, trial: str) -> Dict[str, Dict]:
+    """Heartbeat AGE of every worker publishing one: worker ->
+    {age_secs, incarnation, pid}. The single reader all observers share
+    (panel, supervisor gauges, perf_probe fleet-status) — the record
+    format lives in exactly one writer (_beat) and one parser (here)."""
+    root = names.worker_heartbeat_root(experiment, trial)
+    out: Dict[str, Dict] = {}
+    now = time.time()
+    for key in name_resolve.find_subtree(root):
+        worker = key[len(root.rstrip("/")) + 1:]
+        try:
+            d = json.loads(name_resolve.get(key))
+            out[worker] = {
+                "age_secs": round(now - float(d.get("ts", 0.0)), 3),
+                "incarnation": int(d.get("incarnation", 0)),
+                "pid": d.get("pid"),
+            }
+        except Exception:  # noqa: BLE001 — torn write / stale format
+            out[worker] = {"age_secs": None}
+    return out
 
 
 class WorkerState(str, Enum):
@@ -45,25 +108,159 @@ def worker_control_root(experiment: str, trial: str) -> str:
     return f"{names.trial_root(experiment, trial)}/worker_control/"
 
 
+class HeartbeatThread:
+    """Liveness heartbeat, independent of the worker's loop cadence.
+
+    A dedicated daemon thread (NOT the control-serving loop: a worker
+    blocked in a long jit compile or a paused FSM must still look alive —
+    the lease exists to catch SIGKILLed processes, which take their
+    threads with them) that every ``interval`` seconds:
+
+     - ``touch``es each leased name-resolve key so its ``keepalive_ttl``
+       never lapses while the process lives, and
+     - rewrites ``names.worker_heartbeat`` with {ts, incarnation, pid} so
+       observers (supervisor, perf_probe fleet-status) can report
+       heartbeat age and tell a respawn from its predecessor's ghost.
+    """
+
+    def __init__(self, experiment: str, trial: str, worker_name: str,
+                 keys: Iterable[str] = (), interval: float = 2.0,
+                 incarnation: Optional[int] = None):
+        self.worker_name = worker_name
+        self.incarnation = (
+            incarnation if incarnation is not None else env_incarnation()
+        )
+        # key -> (value, ttl) | None. With the value recorded, a LAPSED
+        # lease (stop-the-world pause, NFS stall, suspend gap longer than
+        # the TTL) is RE-REGISTERED instead of being lost forever — a
+        # live worker must never stay deregistered because one heartbeat
+        # was late.
+        self._keys: Dict[str, Optional[tuple]] = {k: None for k in keys}
+        self._interval = max(float(interval), 0.05)
+        self._lock = threading.Lock()
+        self._hb_key = names.worker_heartbeat(experiment, trial, worker_name)
+        self._stop = threading.Event()
+        self._beat()  # visible before the first interval elapses
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"heartbeat-{worker_name}",
+        )
+        self._thread.start()
+
+    def lease(self, key: str, value: Optional[str] = None,
+              ttl: Optional[float] = None) -> None:
+        """Add a name-resolve key to the touch set. With ``value`` (and
+        optionally ``ttl``) recorded, an expired lease is re-registered
+        on the next beat; without it the key is touch-only (the owner
+        must re-add on expiry)."""
+        with self._lock:
+            self._keys[key] = (value, ttl) if value is not None else None
+
+    def _beat(self) -> None:
+        with self._lock:
+            keys = dict(self._keys)
+        for k, reg in keys.items():
+            try:
+                name_resolve.touch(k)
+            except name_resolve.NameEntryNotFoundError:
+                if reg is None:
+                    continue  # touch-only key: the owner re-registers
+                value, ttl = reg
+                try:
+                    name_resolve.add(k, value, replace=True,
+                                     keepalive_ttl=ttl)
+                    logger.warning(
+                        f"lease on {k} had lapsed (late heartbeat?); "
+                        f"re-registered"
+                    )
+                except Exception:  # noqa: BLE001 — retried next beat
+                    pass
+            except Exception:  # noqa: BLE001 — a heartbeat must never
+                pass  # kill a worker
+        try:
+            name_resolve.add(
+                self._hb_key,
+                json.dumps({"ts": time.time(),
+                            "incarnation": self.incarnation,
+                            "pid": os.getpid()}),
+                replace=True, delete_on_exit=False,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._beat()
+
+    def close(self) -> None:
+        self._stop.set()
+        # Join BEFORE deleting: an in-flight _beat() re-adding the key
+        # after the delete would leave a permanent ghost heartbeat (the
+        # key carries no TTL) that reads as a wedged worker forever.
+        self._thread.join(timeout=2.0)
+        try:
+            name_resolve.delete(self._hb_key)
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
+
+
 class WorkerControl:
     """Worker-side REP endpoint, served between loop iterations."""
 
-    def __init__(self, experiment: str, trial: str, worker_name: str):
+    def __init__(self, experiment: str, trial: str, worker_name: str,
+                 keepalive_ttl: Optional[float] = None,
+                 heartbeat_interval: Optional[float] = None):
         self.worker_name = worker_name
         self.state = WorkerState.CREATED
+        self.incarnation = env_incarnation()
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.REP)
         host = network.gethostip()
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
         self._key = worker_control_key(experiment, trial, worker_name)
-        name_resolve.add(self._key, f"tcp://{host}:{port}", replace=True)
+        # Liveness lease (docs/fault_tolerance.md): under a supervisor the
+        # advertisement expires unless heartbeaten, so a SIGKILLed
+        # worker's ghost endpoint vanishes from panel discovery instead
+        # of hanging every later command against it.
+        if keepalive_ttl is None:
+            keepalive_ttl = env_keepalive_ttl()
+        self._keepalive_ttl = keepalive_ttl
+        addr = f"tcp://{host}:{port}"
+        name_resolve.add(self._key, addr, replace=True,
+                         keepalive_ttl=keepalive_ttl)
+        self._hb: Optional[HeartbeatThread] = None
+        if keepalive_ttl:
+            self._hb = HeartbeatThread(
+                experiment, trial, worker_name,
+                interval=(heartbeat_interval or env_heartbeat_interval()
+                          or keepalive_ttl / 3.0),
+                incarnation=self.incarnation,
+            )
+            self._hb.lease(self._key, addr, keepalive_ttl)
         self._reconfigure_cb: Optional[Callable[[Any], Any]] = None
+        self._commands: Dict[str, Callable[[Any], Any]] = {}
         self._t_start = time.monotonic()
         self._iterations = 0
 
     def on_reconfigure(self, cb: Callable[[Any], Any]) -> None:
         """Register the worker's reconfigure handler (payload → result)."""
         self._reconfigure_cb = cb
+
+    def on_command(self, name: str, cb: Callable[[Any], Any]) -> None:
+        """Register a custom control command (payload → result), served
+        like pause/resume from within ``step`` — including while PAUSED.
+        The master registers ``checkpoint`` this way so a graceful drain
+        can dump a recover checkpoint out-of-band of the ckpt cadence."""
+        self._commands[name] = cb
+
+    def lease(self, key: str, value: Optional[str] = None,
+              ttl: Optional[float] = None) -> None:
+        """Keep an additional name-resolve key alive on this worker's
+        heartbeat (e.g. the trainer's request-stream advertisement);
+        with ``value`` given, a lapsed lease is re-registered. No-op
+        without a keepalive lease."""
+        if self._hb is not None:
+            self._hb.lease(key, value, ttl or self._keepalive_ttl)
 
     @property
     def should_exit(self) -> bool:
@@ -73,6 +270,7 @@ class WorkerControl:
         d = {
             "worker": self.worker_name,
             "state": self.state.value,
+            "incarnation": self.incarnation,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
             "iterations": self._iterations,
         }
@@ -106,6 +304,12 @@ class WorkerControl:
                 return {"ok": True, "result": res}
             except Exception as e:  # noqa: BLE001 — reported to the panel
                 return {"ok": False, "error": str(e)}
+        if cmd in self._commands:
+            try:
+                res = self._commands[cmd](msg.get("payload"))
+                return {"ok": True, "result": res}
+            except Exception as e:  # noqa: BLE001 — reported to the panel
+                return {"ok": False, "error": str(e)}
         return {"ok": False, "error": f"unknown command {cmd!r}"}
 
     def step(
@@ -131,6 +335,8 @@ class WorkerControl:
                 return self.state
 
     def close(self) -> None:
+        if self._hb is not None:
+            self._hb.close()
         # Withdraw the advertisement so a restarted experiment's panel
         # never resolves this dead endpoint (stale-address hang).
         try:
@@ -201,11 +407,30 @@ class WorkerControlPanel:
     def reconfigure(self, worker: str, payload: Any) -> Dict:
         return self.command(worker, "reconfigure", payload=payload)
 
+    def try_command(self, worker: str, cmd: str, **kw) -> Dict:
+        """``command`` that reports a timeout instead of raising — drain
+        sequences keep going past one unresponsive worker."""
+        try:
+            return self.command(worker, cmd, **kw)
+        except TimeoutError as e:
+            return {"ok": False, "error": str(e)}
+
     def pause_all(self) -> Dict[str, Dict]:
         return {w: self.pause(w) for w in self.list_workers()}
 
     def resume_all(self) -> Dict[str, Dict]:
         return {w: self.resume(w) for w in self.list_workers()}
+
+    def exit_all(self) -> Dict[str, Dict]:
+        return {w: self.try_command(w, "exit")
+                for w in self.list_workers()}
+
+    def heartbeats(self) -> Dict[str, Dict]:
+        """Heartbeat AGE of every worker publishing one: worker ->
+        {age_secs, incarnation, pid}. A large age with a live process
+        means a wedged worker; a missing entry means no heartbeat was
+        ever configured (no supervisor / leases disabled)."""
+        return read_heartbeats(self.experiment, self.trial)
 
     def close(self) -> None:
         for s in self._socks.values():

@@ -175,6 +175,56 @@ def test_prometheus_rendering():
         assert name and " " not in name
 
 
+def test_prometheus_inline_label_suffix():
+    """Registry keys may carry an inline label suffix
+    (`supervisor/restarts{worker_kind=rollout}`): samples of the same
+    family render under ONE # TYPE line with merged labels — the idiom
+    the supervisor uses for per-kind restart counters (ISSUE 9
+    acceptance: supervisor_restarts_total{worker_kind=...})."""
+    r = telemetry.TelemetryRegistry()
+    r.inc("supervisor/restarts{worker_kind=rollout}", 2)
+    r.inc("supervisor/restarts{worker_kind=gen_fleet}")
+    r.set_gauge("supervisor/crash_loop_open{worker_kind=rollout}", 0)
+    text = telemetry.render_prometheus(r.snapshot(),
+                                       labels={"host": "h0"})
+    lines = text.splitlines()
+    assert lines.count("# TYPE areal_supervisor_restarts_total counter") == 1
+    assert ('areal_supervisor_restarts_total'
+            '{host="h0",worker_kind="rollout"} 2') in lines
+    assert ('areal_supervisor_restarts_total'
+            '{host="h0",worker_kind="gen_fleet"} 1') in lines
+    assert ('areal_supervisor_crash_loop_open'
+            '{host="h0",worker_kind="rollout"} 0') in lines
+
+
+def _fake_agg_render(snap):
+    """Render one worker's snapshot through the aggregator's merged
+    exposition path without constructing a live aggregator."""
+    import types
+
+    from areal_tpu.base import telemetry as T
+
+    empty = {"counters": {}, "gauges": {}, "hists": {}}
+    fake = types.SimpleNamespace(
+        merged=lambda: {"master:0": snap},
+        stitcher=types.SimpleNamespace(registry=types.SimpleNamespace(
+            snapshot=lambda reset=False: empty,
+        )),
+    )
+    return T.TelemetryAggregator.render_prometheus(fake)
+
+
+def test_aggregator_exposition_inline_labels():
+    r = telemetry.TelemetryRegistry()
+    r.inc("supervisor/restarts{worker_kind=rollout}", 3)
+    text = _fake_agg_render(r.snapshot())
+    lines = text.splitlines()
+    # worker_kind from the key WINS over the identity label (master:0)
+    assert ('areal_supervisor_restarts_total'
+            '{worker_index="0",worker_kind="rollout"} 3') in lines
+    assert lines.count("# TYPE areal_supervisor_restarts_total counter") == 1
+
+
 # ---------------------------------------------------------------------------
 # aggregator merge across fake workers
 # ---------------------------------------------------------------------------

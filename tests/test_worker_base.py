@@ -132,6 +132,47 @@ def test_consumed_log_roundtrip(tmp_path):
     assert "x" in mem
 
 
+def test_custom_command_served_even_while_paused(tmp_name_resolve):
+    """on_command handlers (e.g. the master's out-of-band `checkpoint`)
+    are dispatched from within step() — including from the PAUSED loop,
+    which is exactly where the graceful drain invokes them."""
+    calls = []
+    stop = threading.Event()
+
+    def worker():
+        ctrl = WorkerControl(EXP, TRIAL, "cmd0")
+        ctrl.on_command("checkpoint",
+                        lambda p: calls.append(p) or {"saved": True})
+        while not stop.is_set():
+            ctrl.step()
+            if ctrl.should_exit:
+                break
+            time.sleep(0.005)
+        ctrl.close()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    panel = WorkerControlPanel(EXP, TRIAL)
+    try:
+        r = panel.command("cmd0", "checkpoint", payload={"k": 1})
+        assert r["ok"] and r["result"] == {"saved": True}
+        assert calls == [{"k": 1}]
+        # while paused, the command is still served (pause loop)
+        assert panel.pause("cmd0")["state"] == WorkerState.PAUSED.value
+        r = panel.command("cmd0", "checkpoint")
+        assert r["ok"]
+        assert panel.status("cmd0")["state"] == WorkerState.PAUSED.value
+        # unknown commands still error cleanly
+        r = panel.command("cmd0", "no_such_cmd")
+        assert not r["ok"] and "unknown command" in r["error"]
+        panel.exit("cmd0")
+        t.join(timeout=5)
+        assert not t.is_alive()
+    finally:
+        stop.set()
+        panel.close()
+
+
 def test_freq_ctl_state_roundtrip():
     """RecoverInfo freq-ctl states: a restored controller keeps its
     last-fired anchors instead of re-firing immediately."""

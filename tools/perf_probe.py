@@ -35,6 +35,23 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
   flight-dump <exp> <trial> <dir>     ask EVERY live worker to dump its
                                       flight-recorder ring to
                                       <dir>/flight_<worker>.jsonl
+  fleet-status <exp> <trial>          supervision view of a live run:
+                                      per-worker heartbeat ages +
+                                      incarnations (name-resolve
+                                      liveness leases), the drain phase,
+                                      and the supervisor restart /
+                                      crash-loop counters from the
+                                      merged Prometheus scrape
+                                      (docs/fault_tolerance.md)
+  drain <exp> <trial>                 graceful preemption drain of a
+                                      LIVE run: pause the rollout fleet,
+                                      dump an out-of-band recover
+                                      checkpoint via the master's
+                                      control channel, then exit the
+                                      workers in order (the launcher
+                                      tears down the rest when the
+                                      master returns) —
+                                      docs/operations.md runbook
   decode-bench <server_url> [n_requests] [max_tokens]
                                       drive a LIVE generation server with
                                       a mixed-class synthetic workload
@@ -251,6 +268,81 @@ def flight_dump(experiment: str, trial: str, out_dir: str) -> None:
           f"within one telemetry flush interval (~2s at defaults)")
 
 
+def fleet_status(experiment: str, trial: str) -> None:
+    """Supervision view of a live run (jax-free): heartbeat ages and
+    incarnations from the name-resolve liveness keys, the graceful-drain
+    phase, and the supervisor restart counters filtered out of the
+    merged Prometheus scrape (when telemetry is up)."""
+    import json as _json
+    import urllib.request
+
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.system.worker_base import WorkerControlPanel
+
+    panel = WorkerControlPanel(experiment, trial, timeout=2.0)
+    try:
+        hbs = panel.heartbeats()
+        if hbs:
+            print("heartbeats (liveness leases):")
+            w = max(len(k) for k in hbs)
+            for worker, d in sorted(hbs.items()):
+                age = d.get("age_secs")
+                print(f"  {worker:<{w}}  "
+                      f"age={'?' if age is None else f'{age:.1f}s'}  "
+                      f"incarnation={d.get('incarnation', '?')}  "
+                      f"pid={d.get('pid', '?')}")
+        else:
+            print("no heartbeats registered (run not supervised, or "
+                  "fault_tolerance.keepalive_ttl_secs=0)")
+        workers = panel.list_workers()
+        print(f"control endpoints: {', '.join(workers) or 'none'}")
+    finally:
+        panel.close()
+    try:
+        d = _json.loads(name_resolve.get(
+            names.drain_status(experiment, trial)
+        ))
+        print(f"drain phase: {d.get('phase')} "
+              f"(at {time.strftime('%H:%M:%S', time.localtime(d.get('ts', 0)))})")
+    except Exception:  # noqa: BLE001 — no drain ever requested
+        print("drain phase: none")
+    try:
+        url = name_resolve.get(names.telemetry_http(experiment, trial))
+        with urllib.request.urlopen(f"{url.rstrip('/')}/metrics",
+                                    timeout=10) as r:
+            body = r.read().decode()
+        lines = [ln for ln in body.splitlines()
+                 if "areal_supervisor_" in ln and not ln.startswith("#")]
+        if lines:
+            print("supervisor metrics (merged scrape):")
+            for ln in lines:
+                print(f"  {ln}")
+        else:
+            print("supervisor metrics: none yet (no restarts)")
+    except Exception:  # noqa: BLE001 — telemetry off / no http port
+        print("supervisor metrics: merged scrape unavailable "
+              "(telemetry disabled or no http_port)")
+
+
+def drain(experiment: str, trial: str) -> None:
+    """Trigger the graceful-drain sequence against a live run — the same
+    path the launcher's SIGTERM handler drives (docs/operations.md)."""
+    import json as _json
+
+    from areal_tpu.system.supervisor import drain_experiment
+
+    report = drain_experiment(experiment, trial)
+    print(_json.dumps(report, indent=2, sort_keys=True))
+    ck = report.get("checkpoint") or {}
+    res = ck.get("result") or {}
+    if res.get("saved"):
+        print(f"recover checkpoint: {res.get('dir')} "
+              f"(step {res.get('step')})")
+    else:
+        print("WARNING: no recover checkpoint was written "
+              f"({ck.get('error') or res.get('reason') or 'master absent'})")
+
+
 def profile_trigger(experiment: str, trial: str, out_dir: str,
                     secs: float = 5.0) -> None:
     from areal_tpu.base import telemetry
@@ -409,11 +501,16 @@ def blocksweep(T: int = 1792, S: int = 1792, out_path: str = None,
 def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
                                    "flight-dump", "packfill", "blocksweep",
-                                   "profile-trigger", "profile-status"):
+                                   "profile-trigger", "profile-status",
+                                   "fleet-status", "drain"):
         return False
     cmd = argv[0]
     try:
-        if cmd == "scrape":
+        if cmd == "fleet-status":
+            fleet_status(argv[1], argv[2])
+        elif cmd == "drain":
+            drain(argv[1], argv[2])
+        elif cmd == "scrape":
             if len(argv) > 2:
                 scrape_fleet(argv[1], argv[2])
             else:
