@@ -32,6 +32,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 # live in the dependency-free api.train_config so that parsing configs
 # never drags in jax/optax (CPU-only children, `--help`).
 from areal_tpu.api.train_config import (  # noqa: F401
+    AutoscaleConfig,
     ExperimentSaveEvalControl,
     FaultToleranceConfig,
     OptimizerConfig,
@@ -215,6 +216,14 @@ class BaseExperimentConfig:
     fault_tolerance: FaultToleranceConfig = dataclasses.field(
         default_factory=FaultToleranceConfig
     )
+    # Elastic generation-fleet autoscaling (docs/fault_tolerance.md
+    # §Autoscaling): off by default — `autoscale.enabled=true` turns on
+    # the gserver manager's scaling loop (telemetry-driven target size,
+    # cordon-and-drain scale-down, straggler defense, overload
+    # backpressure) and the launcher-side spawn executor.
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig
+    )
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10
     # Test-only: use the deterministic mock tokenizer instead of HF.
@@ -389,6 +398,48 @@ def validate_config(cfg) -> None:
             f"mode={mode!r} is not supported: valid modes are "
             f"{', '.join(VALID_MODES)} (docs/operations.md §Launching)"
         )
+    nr = getattr(getattr(cfg, "cluster", None), "name_resolve", None)
+    if nr is not None and getattr(nr, "type", "nfs") == "etcd3":
+        # Same contract as the mode=ray rejection above: the descoped
+        # backend must fail while the operator is still at the command
+        # line, not as a NotImplementedError after workers spawned.
+        raise ConfigError(
+            "cluster.name_resolve.type='etcd3' is descoped: no etcd3 "
+            "repository is implemented and the etcd3 client package is "
+            "not in the TPU image. Use type=nfs (shared filesystem, the "
+            "default, works across hosts) or type=memory (single-process "
+            "tests). An etcd3 backend would slot in at "
+            "base/name_resolve.py:reconfigure."
+        )
+    asc = getattr(cfg, "autoscale", None)
+    if asc is not None and getattr(asc, "enabled", False):
+        if asc.min_servers < 1:
+            raise ConfigError(
+                f"autoscale.min_servers={asc.min_servers} must be >= 1 "
+                f"(the fleet can never scale to zero routable servers)"
+            )
+        if asc.max_servers < asc.min_servers:
+            raise ConfigError(
+                f"autoscale.max_servers={asc.max_servers} < "
+                f"min_servers={asc.min_servers}"
+            )
+        if asc.interval_secs <= 0:
+            raise ConfigError(
+                f"autoscale.interval_secs={asc.interval_secs} must be > 0"
+            )
+        if not 0.0 <= asc.down_utilization < asc.up_utilization:
+            raise ConfigError(
+                f"autoscale utilization thresholds must satisfy "
+                f"0 <= down ({asc.down_utilization}) < up "
+                f"({asc.up_utilization}) — equal or inverted thresholds "
+                f"make the fleet flap every interval"
+            )
+        if asc.straggler_defense and asc.straggler_factor <= 1.0:
+            raise ConfigError(
+                f"autoscale.straggler_factor={asc.straggler_factor} must "
+                f"be > 1 (a server is only a straggler when it is slower "
+                f"than its peers)"
+            )
     serving = getattr(cfg, "serving", None)
     if serving is not None and getattr(serving, "enabled", False):
         # Bad serving bucket lists raise ValueError inside every spawned

@@ -149,6 +149,72 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class AutoscaleConfig:
+    """Elastic generation-fleet autoscaling (system/autoscaler.py,
+    docs/fault_tolerance.md §Autoscaling).
+
+    Off by default. Enabled, the gserver manager hosts a slow control
+    loop that computes a target fleet size from live telemetry signals
+    (rollout capacity utilization, per-server queue depth, staleness
+    gate, time-to-first-chunk SLO misses, weight-fanout ack latency,
+    heartbeat ages) with hysteresis + cooldown, publishes the plan
+    through name_resolve, and the launcher-side executor spawns
+    supervised single-server workers to meet it. Scale-down and
+    straggler defense go through the manager's **cordon** state: the
+    server stops receiving leases, inflight rollouts drain (or fail
+    over), then a WorkerControl-commanded exit reaps the process."""
+
+    enabled: bool = False
+    # Fleet-size bounds on the ROUTABLE server count. min_servers should
+    # not exceed the baseline fleet unless scale-up capacity exists.
+    min_servers: int = 1
+    max_servers: int = 4
+    # Decision cadence of the manager-side control loop.
+    interval_secs: float = 5.0
+    # ---- scale-up / scale-down pressure thresholds ----
+    # Rollout capacity utilization (running / max_concurrent_rollouts).
+    up_utilization: float = 0.85
+    down_utilization: float = 0.25
+    # Mean per-server decode queue depth (reported by /health).
+    queue_high: float = 8.0
+    queue_low: float = 1.0
+    # Time-to-first-chunk SLO: a server whose recent TTFC EWMA exceeds
+    # this is an SLO miss; scale up when >= slo_miss_fraction of the
+    # fleet misses. 0 disables the SLO signal.
+    slo_ttfc_secs: float = 0.0
+    slo_miss_fraction: float = 0.5
+    # Weight-fanout ack latency high-water (0 disables): a fleet too
+    # busy to ack weight pushes promptly needs more capacity.
+    fanout_ack_high_secs: float = 0.0
+    # ---- hysteresis + cooldown (both directions move 1 server/step) ----
+    up_consecutive: int = 2
+    down_consecutive: int = 5
+    scale_up_cooldown_secs: float = 30.0
+    scale_down_cooldown_secs: float = 120.0
+    # ---- cordon-and-drain ----
+    # How long a cordoned server may drain its inflight rollouts before
+    # the exit proceeds anyway (clients fail over via chunk replay).
+    drain_timeout_secs: float = 120.0
+    # ---- straggler defense (per-server decode-latency EWMAs) ----
+    straggler_defense: bool = True
+    # A server is "slow" when its decode EWMA exceeds factor x the
+    # median of its peers (self excluded) for consecutive sweeps:
+    # deprioritized after straggler_slow_sweeps, cordoned after
+    # straggler_cordon_sweeps. Samples below floor_secs are noise.
+    straggler_factor: float = 3.0
+    straggler_min_probes: int = 5
+    straggler_slow_sweeps: int = 2
+    straggler_cordon_sweeps: int = 6
+    straggler_floor_secs: float = 0.002
+    # ---- overload backpressure ----
+    # When the fleet is pinned at max_servers and still saturated,
+    # /allocate_rollout capacity denials carry this Retry-After hint so
+    # rollout workers slow prompt admission instead of hammering the
+    # gate every 0.5s.
+    backpressure_retry_secs: float = 2.0
+
+
+@dataclasses.dataclass
 class FaultToleranceConfig:
     """Launcher-level supervision + liveness (system/supervisor.py,
     docs/fault_tolerance.md).

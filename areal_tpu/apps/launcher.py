@@ -149,13 +149,30 @@ def trainer_entry(exp_cfg, trainer_cfg, force_cpu: bool) -> None:
     TrainerWorker(trainer_cfg).run()
 
 
+def _build_gen_model(init: Dict):
+    """Model config + params for a generation server, from the actor's
+    init dict (tiny test config or an HF checkpoint dir)."""
+    import jax
+
+    if "tiny" in init:
+        from areal_tpu.models import transformer
+        from areal_tpu.models.config import tiny_config
+
+        kw = dict(init["tiny"])
+        seed = kw.pop("seed", 0)
+        cfg = tiny_config(**kw)
+        return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
+    from areal_tpu.models import hf as hfmod
+
+    cfg, params, _ = hfmod.load_hf_model(init["hf_dir"])
+    return cfg, params
+
+
 def gen_fleet_entry(exp_cfg, server_cfgs, manager_cfg, force_cpu: bool,
                     chips: Optional[List[int]] = None) -> None:
     """All generation servers + the gserver manager in one asyncio loop."""
     _child_init(exp_cfg, force_cpu, chips)
     import asyncio
-
-    import jax
 
     from areal_tpu.experiments.common import model_init_dict
     from areal_tpu.system.generation_server import GenerationServer
@@ -163,22 +180,8 @@ def gen_fleet_entry(exp_cfg, server_cfgs, manager_cfg, force_cpu: bool,
 
     init = model_init_dict(exp_cfg.actor)
 
-    def build_model():
-        if "tiny" in init:
-            from areal_tpu.models import transformer
-            from areal_tpu.models.config import tiny_config
-
-            kw = dict(init["tiny"])
-            seed = kw.pop("seed", 0)
-            cfg = tiny_config(**kw)
-            return cfg, transformer.init_params(cfg, jax.random.PRNGKey(seed))
-        from areal_tpu.models import hf as hfmod
-
-        cfg, params, _ = hfmod.load_hf_model(init["hf_dir"])
-        return cfg, params
-
     async def main():
-        cfg, params = build_model()
+        cfg, params = _build_gen_model(init)
         tok = _resolve_tokenizer(exp_cfg)
         eos = getattr(tok, "eos_token_id", None)
         servers = []
@@ -192,6 +195,69 @@ def gen_fleet_entry(exp_cfg, server_cfgs, manager_cfg, force_cpu: bool,
         await mgr.start()
         while True:  # runs until the launcher terminates us
             await asyncio.sleep(3600)
+
+    asyncio.run(main())
+
+
+def gen_server_entry(exp_cfg, server_cfg, force_cpu: bool,
+                     chips: Optional[List[int]] = None) -> None:
+    """One supervised generation server — the autoscaler's scale-up unit
+    (docs/fault_tolerance.md §Autoscaling).
+
+    Spawned by the launcher's AutoscaleExecutor to satisfy the gserver
+    manager's published plan. The server joins the fleet through the
+    normal path: registers under names.gen_servers, passes the manager's
+    health gate, and is reconciled to the current weight version over the
+    streamed transport (no checkpoint round-trip). It also serves a
+    WorkerControl endpoint (``genserver_<server_id>``) so a drained
+    cordon ends in a commanded clean exit the supervisor expects."""
+    _child_init(exp_cfg, force_cpu, chips)
+    import asyncio
+
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.experiments.common import model_init_dict
+    from areal_tpu.system.generation_server import GenerationServer
+    from areal_tpu.system.worker_base import WorkerControl
+
+    init = model_init_dict(exp_cfg.actor)
+
+    async def main():
+        cfg, params = _build_gen_model(init)
+        tok = _resolve_tokenizer(exp_cfg)
+        eos = getattr(tok, "eos_token_id", None)
+        if eos is not None:
+            server_cfg.eos_token_id = int(eos)
+        srv = GenerationServer(server_cfg, cfg, params)
+        await srv.start()
+        ctrl = WorkerControl(
+            exp_cfg.experiment_name, exp_cfg.trial_name,
+            f"genserver_{server_cfg.server_id}",
+        )
+        try:
+            while True:
+                await asyncio.to_thread(
+                    ctrl.step,
+                    lambda: {
+                        "server_id": server_cfg.server_id,
+                        "version": srv.version,
+                        "inflight": srv._inflight,
+                    },
+                    200,
+                )
+                if ctrl.should_exit:
+                    break
+        finally:
+            await srv.stop()
+            # Withdraw discovery NOW: the manager's next sweep forgets
+            # this url instead of probing a corpse until the lease TTL.
+            try:
+                name_resolve.delete(names.gen_servers(
+                    exp_cfg.experiment_name, exp_cfg.trial_name,
+                    server_cfg.server_id,
+                ))
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+            ctrl.close()
 
     asyncio.run(main())
 
@@ -237,6 +303,7 @@ class LocalLauncher:
         self.ft = (getattr(exp_cfg, "fault_tolerance", None)
                    or FaultToleranceConfig())
         self.supervisor = None  # built in run() once the trial resolves
+        self._scaler = None  # AutoscaleExecutor, when autoscale.enabled
         self._drain_evt = threading.Event()
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_deadline: Optional[float] = None
@@ -253,12 +320,12 @@ class LocalLauncher:
         return self.supervisor.procs() if self.supervisor else []
 
     def _spawn(self, target, *args, name: str, kind: str,
-               required: bool = True) -> None:
+               required: bool = True, expendable: bool = False) -> None:
         from areal_tpu.system.supervisor import WorkerSpec
 
         self.supervisor.spawn(WorkerSpec(
             name=name, kind=kind, target=target, args=args,
-            required=required,
+            required=required, expendable=expendable,
         ))
 
     @staticmethod
@@ -407,6 +474,9 @@ class LocalLauncher:
                             name=f"rollout{i}", kind="rollout",
                             required=getattr(rc, "max_rollouts",
                                              None) is None)
+            asc = getattr(exp, "autoscale", None)
+            if asc is not None and getattr(asc, "enabled", False):
+                self._scaler = self._build_scaler(exp, setup)
 
         evaluator = None
         if getattr(exp, "auto_eval", False):
@@ -465,6 +535,49 @@ class LocalLauncher:
             self.shutdown()
         return result
 
+    def _build_scaler(self, exp, setup: Dict[str, Any]):
+        """The launcher-side actuator of the manager's autoscale plan:
+        spawns supervised single-server workers (gen_server_entry) from a
+        clone of the baseline server spec. Dynamic servers are
+        ``required=False`` (their WorkerControl-commanded exit after a
+        drain is expected) and ``expendable`` (a crash loop removes them
+        from the fleet instead of escalating — the plan replaces them)."""
+        import copy
+
+        from areal_tpu.system.autoscaler import AutoscaleExecutor
+
+        template = setup["gen_servers"][0]
+        if not self.force_cpu:
+            # Dynamic servers have no reserved chips on this host: a
+            # second JAX process claiming the baseline fleet's chips
+            # would abort both. Multi-host/pod launchers place dynamic
+            # servers on hosts with free capacity; locally the executor
+            # still runs (the plan is visible in fleet-status) but spawn
+            # capacity is whatever the platform tolerates.
+            logger.warning(
+                "autoscale: dynamic generation servers on a single TPU "
+                "host share the gen chip set; scale-up beyond the "
+                "baseline fleet is intended for CPU runs or multi-host "
+                "placement (docs/operations.md §Capacity planning)"
+            )
+
+        def _spawn_dyn(server_id: str) -> None:
+            sc = copy.deepcopy(template)
+            sc.server_id = server_id
+            sc.port = None
+            # chips=None: dynamic servers are unpinned (see the warning
+            # above for the single-host TPU caveat).
+            self._spawn(
+                gen_server_entry, exp, sc, self.force_cpu, None,
+                name=f"genserver_{server_id}", kind="gen_server",
+                required=False, expendable=True,
+            )
+
+        return AutoscaleExecutor(
+            exp.experiment_name, exp.trial_name, self.supervisor,
+            _spawn_dyn,
+        )
+
     def _run_master_monitored(self, master) -> Dict[str, Any]:
         result: Dict[str, Any] = {}
         err: List[BaseException] = []
@@ -493,6 +606,12 @@ class LocalLauncher:
                     "graceful drain failed or timed out; forcing teardown"
                 )
             self._check_children()
+            if self._scaler is not None:
+                try:
+                    self._scaler.step()
+                except Exception as e:  # noqa: BLE001 — scaling is
+                    # best-effort; the run must not die on a bad plan
+                    logger.warning(f"autoscale executor step failed: {e}")
             t.join(timeout=1.0)
         if err:
             raise err[0]

@@ -9,7 +9,12 @@ This implementation provides:
  - ``MemoryNameRecordRepo``   — in-process dict (single-process tests/local).
  - ``NfsNameRecordRepo``      — files under a shared directory (multi-process
    on one host or over NFS; the default for tests and local launches).
- - ``Etcd3NameRecordRepo``    — optional, only if etcd3 is importable.
+
+An etcd3-backed repository is deliberately NOT implemented (the etcd3
+client package is not in the TPU image): ``NameResolveConfig.type="etcd3"``
+is rejected at config-parse time by ``api.cli_args.validate_config`` with
+guidance, and :func:`reconfigure` raises as a backstop for programmatic
+callers. A real backend would slot in at :func:`reconfigure`.
 
 Keys are slash-separated; values are short strings. ``add(..., replace=...)``,
 ``get``, ``wait``, ``delete``, ``get_subtree``, ``find_subtree``, and
@@ -342,9 +347,9 @@ class NfsNameRecordRepo(NameRecordRepository):
 class NameResolveConfig:
     """Mirrors the reference's NameResolveConfig (realhf/api/cli_args.py:872)."""
 
-    type: str = "nfs"  # memory | nfs | etcd3
+    type: str = "nfs"  # memory | nfs ("etcd3" is rejected at config parse)
     nfs_record_root: Optional[str] = None
-    etcd3_addr: Optional[str] = None
+    etcd3_addr: Optional[str] = None  # kept for CLI parity; unused
 
 
 DEFAULT_REPO: NameRecordRepository = NfsNameRecordRepo()
@@ -356,9 +361,13 @@ def reconfigure(config: NameResolveConfig) -> None:
         DEFAULT_REPO = MemoryNameRecordRepo()
     elif config.type == "nfs":
         DEFAULT_REPO = NfsNameRecordRepo(config.nfs_record_root)
-    elif config.type == "etcd3":  # pragma: no cover - optional dependency
+    elif config.type == "etcd3":
+        # Backstop for programmatic callers; the CLI path rejects this
+        # earlier (and with the same guidance) in cli_args.validate_config.
         raise NotImplementedError(
-            "etcd3 backend requires the etcd3 package, not available in this image"
+            "name_resolve type='etcd3' is descoped: no etcd3 repository is "
+            "implemented and the etcd3 package is not in this image — use "
+            "type='nfs' (multi-host) or type='memory' (single-process)"
         )
     else:
         raise ValueError(f"unknown name_resolve type {config.type}")

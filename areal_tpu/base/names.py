@@ -128,6 +128,15 @@ def worker_heartbeat_root(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/heartbeat/"
 
 
+def autoscale_plan(experiment: str, trial: str) -> str:
+    """Fleet-size directive published by the gserver manager's autoscale
+    loop (JSON {target, dynamic, ts, reason}): ``dynamic`` is how many
+    supervisor-spawned single-server workers the launcher-side
+    AutoscaleExecutor should keep alive on top of the baseline gen-fleet
+    process (system/autoscaler.py)."""
+    return f"{_base(experiment, trial)}/autoscale_plan"
+
+
 def drain_status(experiment: str, trial: str) -> str:
     """Graceful-drain phase marker written by supervisor.drain_experiment
     (JSON {phase, ts}): pausing -> checkpoint -> exiting -> done. Read by

@@ -9,7 +9,11 @@ magic numbers.
 ``FaultInjector`` is the test seam: production code calls
 ``maybe_fail("point")`` at failure-prone boundaries (chunk POST, schedule,
 fanout) and tests arm deterministic failures there, so chaos tests run in
-milliseconds instead of waiting on real sockets and TTLs.
+milliseconds instead of waiting on real sockets and TTLs. Points can also
+be armed to *delay* instead of raise (``arm_delay`` + ``maybe_delay``) so
+chaos tests simulate stragglers and slow networks — the sleep function is
+injectable, so fake-clock tests schedule the delays deterministically
+without ever sleeping for real.
 """
 
 from __future__ import annotations
@@ -91,11 +95,29 @@ class FaultInjector:
 
     ``times=-1`` means unlimited until :meth:`disarm`. ``fired`` counts
     triggers per point so tests can assert the failure path actually ran.
+
+    Latency injection (straggler / slow-network simulation)::
+
+        inj = FaultInjector(sleeper=fake_sleep)   # default: asyncio.sleep
+        inj.arm_delay("decode", 0.8, times=-1,
+                      when=lambda ctx: ctx.get("server_id") == "gen1")
+        ...
+        await inj.maybe_delay("decode", server_id=sid)  # awaits sleeper(0.8)
+
+    ``delay_for`` returns the armed delay without sleeping, for call sites
+    that fold it into their own timing (fake servers reporting synthetic
+    decode latency). Delay points are independent of failure points: one
+    name may be armed for both, in which case ``maybe_delay`` sleeps and
+    ``maybe_fail`` raises.
     """
 
-    def __init__(self):
+    def __init__(self, sleeper: Optional[Callable] = None):
         self._armed: Dict[str, dict] = {}
+        self._delays: Dict[str, dict] = {}
         self.fired: Dict[str, int] = {}
+        # Injectable so fake-clock tests advance virtual time instead of
+        # blocking the loop; must be an async callable taking seconds.
+        self.sleeper = sleeper if sleeper is not None else asyncio.sleep
 
     def arm(
         self,
@@ -106,8 +128,42 @@ class FaultInjector:
     ) -> None:
         self._armed[point] = {"times": times, "exc": exc, "when": when}
 
+    def arm_delay(
+        self,
+        point: str,
+        delay_secs: float,
+        times: int = 1,
+        when: Optional[Callable[[dict], bool]] = None,
+    ) -> None:
+        self._delays[point] = {
+            "delay": float(delay_secs), "times": times, "when": when,
+        }
+
     def disarm(self, point: str) -> None:
         self._armed.pop(point, None)
+        self._delays.pop(point, None)
+
+    def delay_for(self, point: str, **ctx) -> float:
+        """The armed delay for this call (0.0 when unarmed / filtered /
+        exhausted). Consumes one ``times`` charge and counts in ``fired``
+        like a failure trigger does."""
+        spec = self._delays.get(point)
+        if spec is None or spec["times"] == 0:
+            return 0.0
+        if spec["when"] is not None and not spec["when"](ctx):
+            return 0.0
+        if spec["times"] > 0:
+            spec["times"] -= 1
+        self.fired[point] = self.fired.get(point, 0) + 1
+        return spec["delay"]
+
+    async def maybe_delay(self, point: str, **ctx) -> float:
+        """Await the armed delay through ``self.sleeper`` (deterministic
+        under fake clocks); returns the seconds slept (0.0 = unarmed)."""
+        d = self.delay_for(point, **ctx)
+        if d > 0.0:
+            await self.sleeper(d)
+        return d
 
     def maybe_fail(self, point: str, **ctx) -> None:
         spec = self._armed.get(point)

@@ -81,6 +81,10 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             realloc_dir=paths["realloc"],
             telemetry=self._telemetry(),
             keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
+            # Elastic fleet (docs/fault_tolerance.md §Autoscaling): the
+            # manager hosts the scaling loop; the launcher-side executor
+            # reads the same config to spawn dynamic servers.
+            autoscale=self.autoscale,
         )
         rollout_workers = [
             RolloutWorkerConfig(

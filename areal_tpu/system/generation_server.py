@@ -130,9 +130,15 @@ class GenerationServer:
     """Owns (cfg, params) of the serving model; hot-swappable."""
 
     def __init__(self, cfg: GenerationServerConfig, model_cfg, params,
-                 mesh=None):
+                 mesh=None, fault_injector=None):
         self.cfg = cfg
         self.model_cfg = model_cfg
+        # Chaos seam (base/retry.py): an armed "decode" delay point
+        # simulates a straggling server — the injected latency lands
+        # inside the measured decode window, so the /health-reported
+        # EWMAs (and the manager's straggler defense) see it exactly
+        # like real slowness.
+        self.faults = fault_injector
         import jax
 
         if mesh is not None:
@@ -156,13 +162,24 @@ class GenerationServer:
         self._runner_task = None
         self._last_update_latency = 0.0
         self._inflight = 0  # /generate requests accepted but not replied
+        # Recent-latency EWMAs reported in /health for the manager's
+        # autoscale signals + straggler defense (per decoded token, and
+        # enqueue -> first tokens of a new generation).
+        self._decode_ewma_secs: Optional[float] = None
+        self._ttfc_ewma_secs: Optional[float] = None
         self._last_stream_stats: Dict[str, float] = {}
-        # server_id "gen3" → worker_index 3 at the aggregator.
-        idx = "".join(c for c in cfg.server_id if c.isdigit())
+        # server_id "gen3" → worker_index 3 at the aggregator. Dynamic
+        # (autoscaler-spawned) "dynN" ids live in a disjoint index range:
+        # the aggregator merges snapshots by (worker_kind, worker_index),
+        # so dyn1 sharing index 1 with baseline gen1 would silently
+        # overwrite its counters/traces/flight dumps.
+        idx = int("".join(c for c in cfg.server_id if c.isdigit()) or 0)
+        if cfg.server_id.startswith("dyn"):
+            idx += 1000
         self.telemetry = (
             telemetry.Telemetry(
                 cfg.experiment, cfg.trial, "generation_server",
-                int(idx or 0), cfg=cfg.telemetry,
+                idx, cfg=cfg.telemetry,
             ) if cfg.telemetry.enabled else telemetry.NULL
         )
         # The serving engine owns queueing, batch formation, retained-KV
@@ -504,6 +521,12 @@ class GenerationServer:
                     trace=p.trace, t_start_wall=p.t_enqueue_wall,
                 )
             try:
+                if self.faults is not None:
+                    # Injected straggler latency: inside the measured
+                    # decode window so the reported EWMAs include it.
+                    await self.faults.maybe_delay(
+                        "decode", server_id=self.cfg.server_id,
+                    )
                 with self.telemetry.span("genserver/decode_chunk",
                                          batch_size=len(batch)) as attrs:
                     results = await asyncio.to_thread(
@@ -517,6 +540,17 @@ class GenerationServer:
                                    attrs["tokens"])
                 dt = time.monotonic() - t_formed
                 t_decode_wall = time.time() - dt
+                chunk_tokens = max(
+                    (len(r["output_ids"]) for r in results), default=0
+                )
+                if chunk_tokens > 0:
+                    # Per-token decode latency EWMA for /health — the
+                    # manager's straggler EWMAs feed off this.
+                    sample = dt / chunk_tokens
+                    self._decode_ewma_secs = (
+                        sample if self._decode_ewma_secs is None
+                        else 0.7 * self._decode_ewma_secs + 0.3 * sample
+                    )
                 for p, r in zip(batch, results):
                     n_tok = len(r["output_ids"])
                     if p.trace is not None:
@@ -532,8 +566,11 @@ class GenerationServer:
                     if p.tokens_done == 0:
                         # Time-to-first-chunk: enqueue → first tokens of a
                         # NEW generation (continuations measure per-token).
-                        self.serving.record_first_chunk(
-                            p.cls, time.monotonic() - p.t_enqueue
+                        ttfc = time.monotonic() - p.t_enqueue
+                        self.serving.record_first_chunk(p.cls, ttfc)
+                        self._ttfc_ewma_secs = (
+                            ttfc if self._ttfc_ewma_secs is None
+                            else 0.7 * self._ttfc_ewma_secs + 0.3 * ttfc
                         )
                     if n_tok:
                         self.serving.record_token_latency(p.cls, dt / n_tok)
@@ -772,6 +809,13 @@ class GenerationServer:
             "version": self.version,
             "server_id": self.cfg.server_id,
             "uptime_secs": time.monotonic() - self._t_start,
+            # Load/latency stats riding the probe: the manager's
+            # autoscale signals (queue depth, TTFC SLO) and straggler
+            # EWMAs come for free with the health sweep it already runs.
+            "queue_depth": self._queue.qsize(),
+            "inflight": self._inflight,
+            "decode_ewma_secs": self._decode_ewma_secs,
+            "ttfc_ewma_secs": self._ttfc_ewma_secs,
         })
 
     def _metrics_dict(self) -> Dict[str, Any]:
@@ -788,6 +832,8 @@ class GenerationServer:
             "version": self.version,
             "inflight_requests": self._inflight,
             "queue_depth": self._queue.qsize(),
+            "decode_ewma_secs": self._decode_ewma_secs or 0.0,
+            "ttfc_ewma_secs": self._ttfc_ewma_secs or 0.0,
             "last_weight_update_latency_s": self._last_update_latency,
             # Stats of the last SUCCESSFUL streamed consume (absent until
             # one lands; a later disk update does not describe these).

@@ -23,6 +23,20 @@ free), a passing check re-admits it after its weights are reconciled to the
 current version, and newly registered servers join through the same gate.
 The weight fanout has a per-server timeout + bounded retry; a server that
 never acks is evicted rather than left silently serving stale weights.
+
+Fleet elasticity (docs/fault_tolerance.md §Autoscaling): with
+``autoscale.enabled`` the manager additionally hosts the slow scaling
+controller (system/autoscaler.py) — target size from telemetry signals
+with hysteresis/cooldown/bounds, scale-up via a published plan the
+launcher-side executor satisfies by spawning supervised single-server
+workers (joining through this manager's discovery + streamed-weight
+admission path), and scale-down / straggler defense / preemption notices
+through the **cordon** state: the server leaves the routing set, its
+inflight rollouts drain on their sticky leases (or fail over), then a
+drained dynamic server gets a WorkerControl-commanded exit. Pinned at
+``max_servers`` under sustained saturation, ``/allocate_rollout`` denials
+carry a Retry-After hint so rollout workers slow prompt admission
+(overload backpressure).
 """
 
 from __future__ import annotations
@@ -34,9 +48,10 @@ import shutil
 import time
 from typing import Dict, List, Optional
 
-from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.api.train_config import AutoscaleConfig, TelemetryConfig
 from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.base.retry import FaultInjector, RetryPolicy, aretry
+from areal_tpu.system import autoscaler as autoscale_mod
 from areal_tpu.system.serving import REQUEST_CLASSES, normalize_class
 
 logger = logging.getLogger("system.gserver_mgr")
@@ -84,6 +99,12 @@ class GserverManagerConfig:
     # every client resolve. 0 falls back to the supervisor-set
     # AREAL_WORKER_KEEPALIVE_TTL env (absent → no lease).
     keepalive_ttl_secs: float = 0.0
+    # Elastic fleet autoscaling + straggler defense + overload
+    # backpressure (system/autoscaler.py, docs/fault_tolerance.md
+    # §Autoscaling). The cordon API works even when disabled.
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig
+    )
 
 
 @dataclasses.dataclass
@@ -98,6 +119,30 @@ class _ServerHealth:
     # resets so an eviction can say WHY, not just which url.
     last_failure: str = ""
     reconciling: bool = False  # re-admission weight push in flight
+    # ---- cordon-and-drain (docs/fault_tolerance.md §Autoscaling) ----
+    # Cordoned: out of the routing set but NOT forgotten — existing
+    # leases stay valid so inflight rollouts drain on their sticky
+    # routes, and the health loop keeps probing but never re-admits
+    # until uncordon. Powers scale-down, straggler defense, and
+    # operator preemption notices alike.
+    cordoned: bool = False
+    cordon_reason: str = ""
+    cordon_deadline: float = 0.0  # monotonic; 0 = no drain in progress
+    exit_commanded: bool = False  # dynamic server already told to exit
+    # Uncordoned but not yet re-admitted by the health gate: counts as
+    # pending capacity so the plan doesn't spawn a spurious replacement
+    # in the one-sweep gap.
+    uncordon_pending: bool = False
+    # ---- per-server stats captured from /health probe bodies ----
+    server_id: str = ""
+    queue_depth: int = 0
+    ttfc_ewma_secs: float = 0.0
+    # Straggler defense: routed only when no faster server is available.
+    deprioritized: bool = False
+
+
+# Read-only fallback for lookups on urls that raced out of self.health.
+_DEFAULT_HEALTH = _ServerHealth()
 
 
 class GserverManager:
@@ -124,9 +169,28 @@ class GserverManager:
         self.accepted_rollouts = 0  # trained samples submitted
         self._watcher_task = None
         self._health_task = None
+        self._autoscale_task = None
         self._reconcile_tasks: set = set()
         self._url: Optional[str] = None
         self.faults = fault_injector
+        # Elastic autoscaling (system/autoscaler.py): the slow scaling
+        # controller riding next to this reactive router. The straggler
+        # tracker runs whenever straggler_defense is on — it only needs
+        # the health loop, not the scaling loop.
+        ac = cfg.autoscale
+        self.autoscaler = (
+            autoscale_mod.AutoscalerCore(ac) if ac.enabled else None
+        )
+        self.straggler = (
+            autoscale_mod.StragglerTracker(
+                factor=ac.straggler_factor,
+                min_probes=ac.straggler_min_probes,
+                slow_sweeps=ac.straggler_slow_sweeps,
+                cordon_sweeps=ac.straggler_cordon_sweeps,
+                floor_secs=ac.straggler_floor_secs,
+            ) if ac.enabled and ac.straggler_defense else None
+        )
+        self._overloaded = False  # pinned at max_servers AND saturated
         # Weight-sync latency bookkeeping (north-star metric #2).
         self.last_sync_fanout_secs: Optional[float] = None
         self.last_sync_e2e_secs: Optional[float] = None
@@ -156,23 +220,37 @@ class GserverManager:
 
     # ---------------- fleet health ----------------
 
+    def _drop_server_leases(self, url: str) -> int:
+        """Retire every lease on ``url`` and forget its inflight slots.
+        Returns the number of leases dropped."""
+        dropped = [lid for lid, (u, _) in self._leases.items() if u == url]
+        for lid in dropped:
+            del self._leases[lid]
+            self._lease_class.pop(lid, None)
+        self._inflight.pop(url, None)
+        self._inflight_cls.pop(url, None)
+        return len(dropped)
+
     def _evict(self, url: str, reason: str) -> None:
         """Remove a server from routing: drain its leases, free its
         inflight slots. The url stays in ``self.health`` so the health loop
         keeps probing it for re-admission."""
         st = self.health.setdefault(url, _ServerHealth())
-        if not st.routable and url not in self.servers:
+        if (
+            not st.routable
+            and url not in self.servers
+            and url not in self._inflight
+            and not any(u == url for u, _ in self._leases.values())
+        ):
+            # Already fully out (a CORDONED server keeps its inflight
+            # bookkeeping until it drains — evicting one, e.g. on
+            # deregistration, must still drop those leases above).
             return
         st.routable = False
         st.evicted_reason = reason
         if url in self.servers:
             self.servers.remove(url)
-        self._inflight.pop(url, None)
-        self._inflight_cls.pop(url, None)
-        dropped = [lid for lid, (u, _) in self._leases.items() if u == url]
-        for lid in dropped:
-            del self._leases[lid]
-            self._lease_class.pop(lid, None)
+        dropped = self._drop_server_leases(url)
         self.telemetry.inc("gsmgr/evictions")
         # The last probe/push failure is the actionable detail (connection
         # refused vs timeout vs bad status) — the reason alone often only
@@ -185,11 +263,11 @@ class GserverManager:
         # flight_gserver_manager0.jsonl (no-op without flight_dir).
         self.telemetry.event(
             "gsmgr/evict", url=url, reason=reason,
-            last_failure=st.last_failure, dropped_leases=len(dropped),
+            last_failure=st.last_failure, dropped_leases=dropped,
         )
         self.telemetry.flight_dump(reason=f"evict {url}: {reason}")
         logger.warning(
-            f"evicted {url} ({reason}{why}); dropped {len(dropped)} leases, "
+            f"evicted {url} ({reason}{why}); dropped {dropped} leases, "
             f"{len(self.servers)} servers remain"
         )
 
@@ -199,13 +277,83 @@ class GserverManager:
             # Deregistered while a reconcile was in flight: stay forgotten
             # rather than resurrecting a permanently-dead url into routing.
             return
+        if st.cordoned:
+            # Cordon survives health recoveries by design — only an
+            # explicit uncordon (operator or autoscaler reclaim) lets the
+            # health loop route this server again.
+            return
         st.routable = True
         st.consecutive_failures = 0
         st.evicted_reason = ""
+        st.uncordon_pending = False
         if url not in self.servers:
             self.servers.append(url)
             self.servers.sort()
         self._inflight.setdefault(url, 0)
+
+    # ---------------- cordon-and-drain ----------------
+
+    def cordon(self, url: str, reason: str, source: str = "operator") -> bool:
+        """Take ``url`` out of the routing set WITHOUT dropping its
+        leases: new requests stop landing, inflight rollouts drain on
+        their sticky routes (or fail over when the server dies), and the
+        health loop keeps probing but never re-admits. Scale-down,
+        straggler defense, and preemption notices all converge here."""
+        st = self.health.get(url)
+        if st is None or st.cordoned:
+            return False
+        st.cordoned = True
+        st.cordon_reason = reason
+        st.routable = False
+        st.deprioritized = False
+        st.exit_commanded = False
+        st.uncordon_pending = False
+        st.cordon_deadline = (
+            time.monotonic() + self.cfg.autoscale.drain_timeout_secs
+        )
+        if url in self.servers:
+            self.servers.remove(url)
+        if self.straggler is not None:
+            self.straggler.forget(url)
+        inflight = self._inflight.get(url, 0)
+        self.telemetry.inc("autoscale/cordons")
+        self.telemetry.inc(f"autoscale/cordons_{source}")
+        self.telemetry.event(
+            "autoscale/cordon", url=url, reason=reason, source=source,
+            inflight=inflight,
+        )
+        logger.warning(
+            f"cordoned {url} ({reason}, source={source}); {inflight} "
+            f"inflight requests draining, {len(self.servers)} servers "
+            f"remain routable"
+        )
+        self._update_fleet_gauges()
+        return True
+
+    def uncordon(self, url: str) -> bool:
+        """Lift a cordon. The server does NOT route immediately: it goes
+        back through the health gate (probe + weight reconcile), exactly
+        like a newly discovered server — its weights may be several
+        versions stale by now."""
+        st = self.health.get(url)
+        if st is None or not st.cordoned:
+            return False
+        st.cordoned = False
+        st.cordon_reason = ""
+        st.cordon_deadline = 0.0
+        st.exit_commanded = False
+        st.consecutive_failures = 0
+        st.uncordon_pending = True
+        self.telemetry.inc("autoscale/uncordons")
+        self.telemetry.event("autoscale/uncordon", url=url)
+        logger.info(f"uncordoned {url}; re-admission via the health gate")
+        return True
+
+    def _server_draining_load(self, url: str) -> int:
+        """Outstanding work pinning a cordoned server: live leases plus
+        any inflight slots they hold."""
+        leases = sum(1 for u, _ in self._leases.values() if u == url)
+        return max(leases, self._inflight.get(url, 0))
 
     def _current_weight_path(self) -> str:
         return os.path.join(
@@ -281,8 +429,31 @@ class GserverManager:
             ):
                 self._evict(url, f"{st.consecutive_failures} consecutive "
                                  f"health failures ({e})")
+            elif (
+                st.cordoned
+                and st.consecutive_failures
+                >= self.cfg.health_failure_threshold
+            ):
+                # A cordoned server died mid-drain: its clients fail over
+                # via chunk replay; retire its leases now so the quota
+                # accounting doesn't wait out the lease TTL.
+                self._drop_server_leases(url)
+            if st.consecutive_failures >= self.cfg.health_failure_threshold:
+                st.uncordon_pending = False  # dead, not pending capacity
             return
         st.consecutive_failures = 0
+        # Per-server load/latency stats ride the probe body — the
+        # autoscale signals and the straggler EWMAs come for free with
+        # the sweep the health loop already pays for.
+        st.server_id = str(body.get("server_id", st.server_id) or "")
+        st.queue_depth = int(body.get("queue_depth", 0) or 0)
+        st.ttfc_ewma_secs = float(body.get("ttfc_ewma_secs", 0.0) or 0.0)
+        decode_ewma = body.get("decode_ewma_secs")
+        if (
+            self.straggler is not None and st.routable
+            and decode_ewma is not None
+        ):
+            self.straggler.observe(url, float(decode_ewma))
         # A passing probe clears the failure detail — otherwise a later
         # eviction via a NON-probe path (version regression, fanout no-ack)
         # would attach an hours-stale probe error as its explanation.
@@ -297,10 +468,11 @@ class GserverManager:
                 url, f"reports v{body.get('version')} < fleet "
                      f"v{version_at_probe} (in-place restart?)"
             )
-        if not st.routable and not st.reconciling:
+        if not st.routable and not st.reconciling and not st.cordoned:
             # Re-admission reconcile runs DETACHED: a slow weight load on
             # one rejoining server must not stall the sweep (and eviction
-            # of other dead servers) for the whole fanout budget.
+            # of other dead servers) for the whole fanout budget. A
+            # CORDONED server never re-admits here — uncordon first.
             st.reconciling = True
             server_version = int(body.get("version", 0))
 
@@ -355,12 +527,63 @@ class GserverManager:
         await asyncio.gather(*[
             self._check_one(sess, u) for u in list(self.health)
         ])
+        self._straggler_sweep()
         self._update_fleet_gauges()
+
+    def _straggler_sweep(self) -> None:
+        """Score every routable server's decode-latency EWMA against its
+        peers (system/autoscaler.py StragglerTracker): persistently slow
+        servers are deprioritized in routing, then cordoned before they
+        wedge the staleness gate by pinning the oldest inflight rollouts
+        on the slowest decode path."""
+        if self.straggler is None or len(self.servers) < 2:
+            return
+        verdicts = self.straggler.sweep(list(self.servers))
+        for url, verdict in verdicts.items():
+            st = self.health.get(url)
+            if st is None or st.cordoned:
+                continue
+            if verdict == "cordon":
+                self.telemetry.inc("autoscale/straggler_cordons")
+                self.cordon(
+                    url,
+                    f"straggler: decode EWMA "
+                    f"{(self.straggler.ewma(url) or 0.0) * 1e3:.1f}ms vs "
+                    f"peers",
+                    source="straggler",
+                )
+            elif verdict == "slow" and not st.deprioritized:
+                st.deprioritized = True
+                self.telemetry.inc("autoscale/straggler_deprioritized")
+                self.telemetry.event(
+                    "autoscale/deprioritize", url=url,
+                    ewma_secs=self.straggler.ewma(url),
+                )
+                logger.warning(
+                    f"{url} deprioritized: decode EWMA "
+                    f"{(self.straggler.ewma(url) or 0.0) * 1e3:.1f}ms is "
+                    f"{self.cfg.autoscale.straggler_factor:.0f}x over the "
+                    f"peer median"
+                )
+            elif verdict == "ok" and st.deprioritized:
+                st.deprioritized = False
+                logger.info(f"{url} back within peer latency; "
+                            f"restored to full routing priority")
+
+    def _cordoned_count(self) -> int:
+        return sum(1 for st in self.health.values() if st.cordoned)
 
     def _update_fleet_gauges(self) -> None:
         t = self.telemetry
         t.set_gauge("gsmgr/healthy_servers", len(self.servers))
         t.set_gauge("gsmgr/known_servers", len(self.health))
+        t.set_gauge("autoscale/cordoned_servers", self._cordoned_count())
+        t.set_gauge("autoscale/current_size", len(self.servers))
+        if self.autoscaler is not None:
+            t.set_gauge("autoscale/target_size", self.autoscaler.target
+                        if self.autoscaler.target is not None
+                        else len(self.servers))
+            t.set_gauge("autoscale/overloaded", float(self._overloaded))
         t.set_gauge("gsmgr/lease_depth", len(self._leases))
         t.set_gauge("gsmgr/running_rollouts", self.running_rollouts)
         t.set_gauge("gsmgr/accepted_rollouts", self.accepted_rollouts)
@@ -393,6 +616,215 @@ class GserverManager:
                     logger.warning(f"health sweep error: {e}")
                 await asyncio.sleep(self.cfg.health_check_interval_secs)
 
+    # ---------------- elastic autoscaling ----------------
+
+    def _stale_heartbeat_urls(self, routable) -> set:
+        """Routable servers whose liveness heartbeat has gone stale (the
+        process is alive per the OS but wedged per the lease) — they
+        don't count as capacity, so the plan replaces them at constant
+        target."""
+        ttl = self.cfg.keepalive_ttl_secs
+        if not ttl:
+            from areal_tpu.system.worker_base import env_keepalive_ttl
+
+            ttl = env_keepalive_ttl() or 0.0
+        if ttl <= 0 or not routable:
+            return set()
+        try:
+            from areal_tpu.system.worker_base import read_heartbeats
+
+            hbs = read_heartbeats(self.cfg.experiment, self.cfg.trial)
+        except Exception:  # noqa: BLE001 — name-resolve hiccup
+            return set()
+        stale_ids = set()
+        for worker, d in hbs.items():
+            if not worker.startswith("genserver_"):
+                continue
+            age = d.get("age_secs")
+            if age is not None and age > 3 * ttl:
+                stale_ids.add(worker[len("genserver_"):])
+        return {
+            u for u in routable
+            if self.health.get(u, _DEFAULT_HEALTH).server_id in stale_ids
+        }
+
+    def _autoscale_signals(self, stale_urls: set
+                           ) -> "autoscale_mod.FleetSignals":
+        ac = self.cfg.autoscale
+        routable = list(self.servers)
+        qd = 0.0
+        slo_frac = 0.0
+        if routable:
+            qd = sum(
+                self.health.get(u, _DEFAULT_HEALTH).queue_depth
+                for u in routable
+            ) / len(routable)
+            if ac.slo_ttfc_secs > 0:
+                slo_frac = sum(
+                    1 for u in routable
+                    if self.health.get(u, _DEFAULT_HEALTH).ttfc_ewma_secs
+                    > ac.slo_ttfc_secs
+                ) / len(routable)
+        return autoscale_mod.FleetSignals(
+            current_size=len(routable),
+            cordoned=self._cordoned_count(),
+            utilization=(
+                self.running_rollouts
+                / max(self.cfg.max_concurrent_rollouts, 1)
+            ),
+            queue_depth=qd,
+            staled=self.is_staled(),
+            slo_miss_frac=slo_frac,
+            fanout_ack_secs=self.last_sync_fanout_secs or 0.0,
+            stale_heartbeats=len(stale_urls),
+        )
+
+    def _pick_scale_down_victim(self) -> Optional[str]:
+        if len(self.servers) <= 1:
+            return None  # never cordon the last routable server
+
+        def key(u):
+            st = self.health.get(u, _DEFAULT_HEALTH)
+            return (
+                0 if st.deprioritized else 1,  # shed slow servers first
+                # Dynamic spawns before baseline: baseline servers share
+                # the gen-fleet process and can only idle, never exit.
+                0 if st.server_id.startswith("dyn") else 1,
+                self._inflight.get(u, 0),  # least work left to drain
+            )
+
+        return min(self.servers, key=key)
+
+    def _autoscale_tick(self) -> None:
+        """One decision interval of the slow scaling controller: feed the
+        core a signals snapshot, act on its verdict (cordon a victim /
+        reclaim a cordoned server), and publish the dynamic-spawn plan
+        the launcher-side executor reconciles against."""
+        ac = self.cfg.autoscale
+        stale_urls = self._stale_heartbeat_urls(list(self.servers))
+        sig = self._autoscale_signals(stale_urls)
+        action = self.autoscaler.observe(sig)
+        self._overloaded = self.autoscaler.overloaded
+        if action is not None:
+            if action["action"] == "up":
+                self.telemetry.inc("autoscale/scale_up")
+            else:
+                self.telemetry.inc("autoscale/target_down")
+            self.telemetry.event("autoscale/retarget", **action)
+            logger.info(
+                f"autoscale: target -> {action['target']} "
+                f"({action['action']}: {action['reason']})"
+            )
+        target = (
+            self.autoscaler.target
+            if self.autoscaler.target is not None else len(self.servers)
+        )
+        if len(self.servers) > target:
+            victim = self._pick_scale_down_victim()
+            if victim is not None:
+                self.cordon(victim, f"scale-down to {target}",
+                            source="autoscaler")
+        elif len(self.servers) < target:
+            # Reclaim the cheapest capacity first: a healthy server this
+            # loop cordoned for scale-down still holds near-current
+            # weights — uncordon beats spawning a cold process.
+            for url, st in self.health.items():
+                if (
+                    st.cordoned
+                    and st.cordon_reason.startswith("scale-down")
+                    and st.consecutive_failures == 0
+                    # Never reclaim a server already told to exit — its
+                    # process is shutting down and a passing probe could
+                    # route leases onto a corpse.
+                    and not st.exit_commanded
+                ):
+                    self.uncordon(url)
+                    break
+        # Wedged (stale-heartbeat) servers stay routable — eviction is
+        # the health loop's call — but don't count as capacity here, so
+        # the plan spawns a replacement WITHOUT moving the target.
+        baseline_alive = sum(
+            1 for url, st in self.health.items()
+            if not st.cordoned
+            and url not in stale_urls
+            and (st.routable or st.reconciling or st.uncordon_pending)
+            and not st.server_id.startswith("dyn")
+        )
+        dynamic = max(0, min(target, ac.max_servers) - baseline_alive)
+        autoscale_mod.publish_plan(self.cfg.experiment, self.cfg.trial, {
+            "target": target,
+            "dynamic": dynamic,
+            "overloaded": self._overloaded,
+            "ts": time.time(),
+        })
+        self._update_fleet_gauges()
+
+    def _command_server_exit(self, server_id: str) -> bool:
+        """WorkerControl-commanded exit of a drained dynamic server (runs
+        in a thread: the panel is sync ZMQ). The supervisor sees the
+        clean exit of a non-required worker — expected, never respawned."""
+        from areal_tpu.system.worker_base import WorkerControlPanel
+
+        panel = WorkerControlPanel(self.cfg.experiment, self.cfg.trial,
+                                   timeout=5.0)
+        try:
+            res = panel.try_command(f"genserver_{server_id}", "exit")
+            return bool(res.get("ok"))
+        except Exception as e:  # noqa: BLE001 — endpoint gone / resolving
+            logger.warning(f"exit command to genserver_{server_id} "
+                           f"failed: {e}")
+            return False
+        finally:
+            panel.close()
+
+    async def _drain_cordoned(self) -> None:
+        """Walk cordoned servers: once one has no outstanding leases (or
+        its drain deadline passed — clients fail over via chunk replay),
+        count the scale-down and, for dynamic servers, command the
+        process exit over WorkerControl."""
+        now = time.monotonic()
+        for url in list(self.health):
+            st = self.health.get(url)
+            if st is None or not st.cordoned or st.exit_commanded:
+                continue
+            load = self._server_draining_load(url)
+            if load > 0 and now < st.cordon_deadline:
+                continue
+            if load > 0:
+                logger.warning(
+                    f"{url} drain deadline passed with {load} leases "
+                    f"outstanding; proceeding (clients fail over via "
+                    f"chunk replay)"
+                )
+                self._drop_server_leases(url)
+            sid = st.server_id
+            if sid.startswith("dyn"):
+                ok = await asyncio.to_thread(self._command_server_exit, sid)
+                if not ok:
+                    continue  # retried next interval
+            st.exit_commanded = True
+            self.telemetry.inc("autoscale/scale_down")
+            self.telemetry.event(
+                "autoscale/drained", url=url, reason=st.cordon_reason,
+                forced=load > 0,
+            )
+            logger.info(
+                f"cordoned server {url} drained ({st.cordon_reason}); "
+                + ("exit commanded" if sid.startswith("dyn")
+                   else "idling in the baseline gen-fleet process")
+            )
+
+    async def _autoscale_loop(self):
+        while True:
+            try:
+                self._autoscale_tick()
+                await self._drain_cordoned()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                logger.warning(f"autoscale tick error: {e}")
+            await asyncio.sleep(self.cfg.autoscale.interval_secs)
+
     # ---------------- scheduling ----------------
 
     def _drop_lease_class(self, lid: str, url: str) -> None:
@@ -419,21 +851,28 @@ class GserverManager:
         self._expire_leases()
         if not self.servers:
             return None
+        # Straggler defense: a deprioritized (persistently slow) server
+        # is routed only when every faster peer is gone — its inflight
+        # work finishes, but new work prefers the healthy set.
+        pool = [
+            u for u in self.servers
+            if not self.health.get(u, _DEFAULT_HEALTH).deprioritized
+        ] or self.servers
         if cls != "rollout":
             # Latency-sensitive classes route to the server carrying the
             # least interactive+eval load (total inflight tie-breaks) —
             # bulk rollout traffic keeps its configured policy, so one
             # fleet serves both without the bulk queue burying the SLOs.
             return min(
-                self.servers,
+                pool,
                 key=lambda u: (
                     self._cls_inflight(u, ("interactive", "eval")),
                     self._inflight.get(u, 0),
                 ),
             )
         if self.cfg.schedule_policy == "least_requests":
-            return min(self.servers, key=lambda u: self._inflight.get(u, 0))
-        url = self.servers[self._rr % len(self.servers)]
+            return min(pool, key=lambda u: self._inflight.get(u, 0))
+        url = pool[self._rr % len(pool)]
         self._rr += 1
         return url
 
@@ -540,7 +979,18 @@ class GserverManager:
         d = await request.json()
         n = int(d.get("n_samples", 1))
         if self.running_rollouts >= self.cfg.max_concurrent_rollouts:
-            return web.json_response({"allowed": False, "reason": "capacity"})
+            resp = {"allowed": False, "reason": "capacity"}
+            if self._overloaded:
+                # Overload backpressure (docs/fault_tolerance.md
+                # §Autoscaling): the fleet is pinned at max_servers and
+                # still saturated — no amount of 0.5s polling will open
+                # the gate sooner, so tell the workers to slow prompt
+                # admission instead of hammering it.
+                resp["retry_after"] = (
+                    self.cfg.autoscale.backpressure_retry_secs
+                )
+                self.telemetry.inc("autoscale/backpressure_denials")
+            return web.json_response(resp)
         if self.is_staled():
             return web.json_response({"allowed": False, "reason": "staleness"})
         self.running_rollouts += n
@@ -584,6 +1034,54 @@ class GserverManager:
 
         return web.json_response({"version": self.version})
 
+    def _resolve_server(self, d: Dict) -> Optional[str]:
+        """Map a {url} or {server_id} request body onto a known url."""
+        url = d.get("url")
+        if url:
+            return url if url in self.health else None
+        sid = str(d.get("server_id") or "")
+        if sid:
+            return next(
+                (u for u, st in self.health.items()
+                 if st.server_id == sid), None,
+            )
+        return None
+
+    async def handle_cordon(self, request):
+        """Operator/preemption cordon: POST {url | server_id, reason}.
+        The server stops receiving leases; inflight rollouts drain (the
+        autoscale loop reaps dynamic servers once drained). This is the
+        preemption-notice hook — `perf_probe cordon` calls it."""
+        from aiohttp import web
+
+        d = await request.json()
+        url = self._resolve_server(d)
+        if url is None:
+            return web.json_response(
+                {"ok": False, "reason": "unknown server"}, status=404
+            )
+        ok = self.cordon(
+            url, str(d.get("reason") or "operator request"),
+            source="operator",
+        )
+        return web.json_response({
+            "ok": ok, "url": url,
+            "draining": self._server_draining_load(url),
+            "already_cordoned": not ok,
+        })
+
+    async def handle_uncordon(self, request):
+        from aiohttp import web
+
+        d = await request.json()
+        url = self._resolve_server(d)
+        if url is None:
+            return web.json_response(
+                {"ok": False, "reason": "unknown server"}, status=404
+            )
+        ok = self.uncordon(url)
+        return web.json_response({"ok": ok, "url": url})
+
     async def handle_metrics(self, request):
         """Prometheus exposition text: fleet gauges (healthy servers,
         lease depth, staleness counters, weight version, sync latency)
@@ -611,7 +1109,18 @@ class GserverManager:
             "gsmgr_staled": float(self.is_staled()),
             "gsmgr_weight_sync_fanout_secs": self.last_sync_fanout_secs,
             "gsmgr_weight_sync_e2e_secs": self.last_sync_e2e_secs,
+            # Fleet elasticity (docs/fault_tolerance.md §Autoscaling) —
+            # present even with telemetry disabled, so a bare scrape of
+            # this endpoint can follow a drain.
+            "autoscale_cordoned_servers": self._cordoned_count(),
+            "autoscale_current_size": len(self.servers),
         }
+        if self.autoscaler is not None:
+            gauges["autoscale_target_size"] = (
+                self.autoscaler.target
+                if self.autoscaler.target is not None else len(self.servers)
+            )
+            gauges["autoscale_overloaded"] = float(self._overloaded)
         body = telemetry.render_prometheus(
             self.telemetry.snapshot(reset=False), extra_gauges=gauges,
         )
@@ -632,6 +1141,16 @@ class GserverManager:
                 c: sum(by.get(c, 0) for by in self._inflight_cls.values())
                 for c in REQUEST_CLASSES
             },
+            "autoscale": {
+                "enabled": self.cfg.autoscale.enabled,
+                "target_size": (
+                    self.autoscaler.target if self.autoscaler is not None
+                    else None
+                ),
+                "current_size": len(self.servers),
+                "cordoned": self._cordoned_count(),
+                "overloaded": self._overloaded,
+            },
             "fleet": {
                 u: {
                     "routable": st.routable,
@@ -639,6 +1158,14 @@ class GserverManager:
                     "acked_version": st.acked_version,
                     "evicted_reason": st.evicted_reason,
                     "last_failure": st.last_failure,
+                    "server_id": st.server_id,
+                    "cordoned": st.cordoned,
+                    "cordon_reason": st.cordon_reason,
+                    "deprioritized": st.deprioritized,
+                    "queue_depth": st.queue_depth,
+                    "draining": (
+                        self._server_draining_load(u) if st.cordoned else 0
+                    ),
                 }
                 for u, st in self.health.items()
             },
@@ -836,6 +1363,8 @@ class GserverManager:
         app.router.add_post("/allocate_rollout", self.handle_allocate_rollout)
         app.router.add_post("/finish_rollout", self.handle_finish_rollout)
         app.router.add_get("/get_model_version", self.handle_get_model_version)
+        app.router.add_post("/cordon", self.handle_cordon)
+        app.router.add_post("/uncordon", self.handle_uncordon)
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_get("/metrics.json", self.handle_metrics_json)
         app.router.add_get("/metrics_discovery", self.handle_metrics_discovery)
@@ -847,6 +1376,10 @@ class GserverManager:
         await self.wait_for_servers()
         self._watcher_task = asyncio.create_task(self._watch_weights())
         self._health_task = asyncio.create_task(self._health_loop())
+        if self.autoscaler is not None:
+            self._autoscale_task = asyncio.create_task(
+                self._autoscale_loop()
+            )
         runner = web.AppRunner(self.build_app())
         await runner.setup()
         port = self.cfg.port or network.find_free_port()
@@ -879,7 +1412,7 @@ class GserverManager:
     async def stop(self):
         tasks = [t for t in
                  [self._watcher_task, self._health_task,
-                  *self._reconcile_tasks] if t]
+                  self._autoscale_task, *self._reconcile_tasks] if t]
         for t in tasks:
             t.cancel()
         # Let cancellations unwind before tearing down the HTTP runner —

@@ -259,7 +259,20 @@ class RolloutWorker:
             telemetry.inc(
                 f"rollout/alloc_denied_{alloc.get('reason', 'unknown')}"
             )
-            await asyncio.sleep(0.5)
+            # Overload backpressure (docs/fault_tolerance.md
+            # §Autoscaling): when the fleet is pinned at its max bound
+            # and saturated, the manager's denial carries a Retry-After
+            # hint — slow prompt admission to its cadence instead of
+            # re-polling the gate every 0.5s from every pending prompt.
+            retry_secs = 0.5
+            if alloc.get("retry_after") is not None:
+                try:
+                    retry_secs = max(float(alloc["retry_after"]), 0.05)
+                except (TypeError, ValueError):
+                    pass
+                else:
+                    telemetry.inc("rollout/backpressure_throttled")
+            await asyncio.sleep(retry_secs)
             return "retry"
         telemetry.observe("rollout/alloc_rpc_secs",
                           time.monotonic() - t_alloc)

@@ -52,7 +52,11 @@ from areal_tpu.base import logging, name_resolve, names, telemetry
 logger = logging.getLogger("system.supervisor")
 
 # Failure domains (docs/fault_tolerance.md §Failure domains).
-STATELESS_KINDS = ("rollout", "gen_fleet")
+# "gen_server" is a dynamically-scaled single generation server spawned by
+# the autoscale executor (system/autoscaler.py) — stateless like the fleet
+# process, but additionally *expendable*: a crash loop removes it from the
+# fleet permanently instead of escalating (the autoscaler replaces it).
+STATELESS_KINDS = ("rollout", "gen_fleet", "gen_server")
 
 
 class SupervisorEscalation(RuntimeError):
@@ -103,6 +107,12 @@ class WorkerSpec:
     # A required worker exiting 0 without an exit request is a failure
     # (the master would block on data-wait forever, not crash).
     required: bool = True
+    # An expendable worker (autoscaler-spawned generation server) that
+    # crash-loops past the circuit breaker is PERMANENTLY REMOVED from
+    # supervision instead of escalating to a whole-experiment relaunch —
+    # the fleet plan replaces it with a fresh spec within its bounds, and
+    # one flapping server never takes the run down with it.
+    expendable: bool = False
 
 
 class _Entry:
@@ -203,6 +213,17 @@ class Supervisor:
         return [e.proc for e in self._entries.values()
                 if e.proc is not None]
 
+    def alive_count(self, kind: str) -> int:
+        """Supervised workers of ``kind`` still in the fleet: running,
+        freshly dead awaiting classification, or scheduled for respawn.
+        Cleanly-exited and permanently-removed (expendable crash-loop)
+        entries don't count — that's how the autoscale executor sees
+        capacity it must replace."""
+        return sum(
+            1 for e in self._entries.values()
+            if e.spec.kind == kind and not e.done
+        )
+
     def begin_drain(self) -> None:
         """Planned teardown from here on: child exits (any code) are
         expected and never restarted or escalated."""
@@ -285,12 +306,30 @@ class Supervisor:
                 f"supervisor/crash_loop_open{{worker_kind={spec.kind}}}",
                 1.0,
             )
-            self._escalate(
-                entry, f"{spec.name} crash-looped: "
-                f"{len(entry.restarts)} restarts inside "
-                f"{self.policy.window_secs:.0f}s (last death: {reason}); "
-                f"circuit breaker open"
-            )
+            msg = (f"{spec.name} crash-looped: "
+                   f"{len(entry.restarts)} restarts inside "
+                   f"{self.policy.window_secs:.0f}s (last death: {reason}); "
+                   f"circuit breaker open")
+            if spec.expendable:
+                # Flapping-server containment: the breaker trips, the
+                # worker leaves the fleet for good, and nothing escalates
+                # — the autoscale plan notices the lost capacity and
+                # spawns a FRESH spec within its bounds.
+                entry.done = True
+                self._clear_ghost_keys(spec)
+                telemetry.inc(
+                    f"supervisor/removed{{worker_kind={spec.kind}}}"
+                )
+                t = telemetry.get()
+                if t.enabled:
+                    t.event("supervisor/removed", worker=spec.name,
+                            kind=spec.kind, reason=msg)
+                logger.error(
+                    f"{msg}; permanently removed (expendable) — the "
+                    f"autoscaler replaces it within bounds"
+                )
+                return
+            self._escalate(entry, msg)
         entry.restarts.append(now)
         backoff = self.policy.backoff(len(entry.restarts))
         entry.respawn_due = now + backoff
@@ -340,6 +379,15 @@ class Supervisor:
             worker_control_key(self.experiment, self.trial, spec.name),
             names.worker_heartbeat(self.experiment, self.trial, spec.name),
         ]
+        if spec.kind == "gen_server":
+            # A dynamic single-server worker (autoscaler spawn): its
+            # discovery registration keys by server_id, which the
+            # launcher names the worker after ("genserver_<server_id>").
+            sid = spec.name
+            if sid.startswith("genserver_"):
+                sid = sid[len("genserver_"):]
+            doomed.append(names.gen_servers(self.experiment, self.trial,
+                                            sid))
         if spec.kind == "gen_fleet":
             # The fleet process hosts the servers AND the manager: clear
             # their discovery keys so rollout clients fail fast and

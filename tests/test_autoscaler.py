@@ -1,0 +1,863 @@
+"""Elastic generation-fleet autoscaling: decision core, cordon-and-drain,
+straggler defense, overload backpressure, and the launcher-side executor.
+
+Covers ISSUE 11 (docs/fault_tolerance.md §Autoscaling):
+ - FaultInjector latency injection (arm_delay/maybe_delay) with an
+   injectable sleeper — deterministic under fake clocks
+ - AutoscalerCore: hysteresis, per-direction cooldowns, [min, max]
+   bounds, staleness-gate inhibition, overload latch at the max bound
+ - StragglerTracker: peer-median scoring (self excluded), slow → cordon
+   streaks, the noise floor
+ - gserver manager: cordon keeps leases draining while blocking new
+   ones, uncordon re-admits through the health gate, eviction of a
+   cordoned server still retires its leases, straggler probes
+   deprioritize then cordon a slow server, capacity denials carry
+   Retry-After only while overloaded, the autoscale tick publishes the
+   dynamic-spawn plan and scale-down cordons + WorkerControl-exits a
+   drained dynamic victim
+ - rollout worker: honors the denial's Retry-After (backpressure)
+ - supervisor: an expendable (autoscaler-spawned) server that
+   crash-loops is permanently removed WITHOUT escalating, and the
+   executor replaces it within the plan's bounds
+
+Every test runs on fake clocks, in-process fakes, or tiny aiohttp fake
+servers — zero real sleeps beyond sub-second aiohttp round-trips.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from areal_tpu.api.train_config import AutoscaleConfig
+from areal_tpu.base import name_resolve, names, network
+from areal_tpu.base.retry import FaultInjector
+from areal_tpu.system.autoscaler import (
+    AutoscaleExecutor,
+    AutoscalerCore,
+    FleetSignals,
+    StragglerTracker,
+    publish_plan,
+    read_plan,
+)
+from areal_tpu.system.gserver_manager import (
+    GserverManager,
+    GserverManagerConfig,
+    _ServerHealth,
+)
+
+EXP, TRIAL = "autoscaletest", "t0"
+
+
+class _Req:
+    def __init__(self, d=None, headers=None):
+        self._d = d or {}
+        self.headers = headers or {}
+
+    async def json(self):
+        return self._d
+
+
+def _cfg(**asc_kw) -> GserverManagerConfig:
+    asc = AutoscaleConfig(enabled=True, **asc_kw)
+    return GserverManagerConfig(experiment=EXP, trial=TRIAL, autoscale=asc)
+
+
+def _mgr(**asc_kw) -> GserverManager:
+    return GserverManager(_cfg(**asc_kw))
+
+
+def _add_server(mgr, url, server_id="", routable=True):
+    st = _ServerHealth(routable=routable)
+    st.server_id = server_id
+    mgr.health[url] = st
+    if routable:
+        mgr.servers.append(url)
+        mgr.servers.sort()
+        mgr._inflight.setdefault(url, 0)
+    return st
+
+
+# ------------------------------------------------------- retry.py delays
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_fault_injector_delay_mode_deterministic():
+    slept = []
+
+    async def fake_sleep(secs):
+        slept.append(secs)  # deterministic: records, never waits
+
+    inj = FaultInjector(sleeper=fake_sleep)
+    inj.arm_delay("decode", 0.8, times=2,
+                  when=lambda ctx: ctx.get("server_id") == "gen1")
+
+    async def main():
+        # Filtered out: wrong server.
+        assert await inj.maybe_delay("decode", server_id="gen0") == 0.0
+        assert await inj.maybe_delay("decode", server_id="gen1") == 0.8
+        assert await inj.maybe_delay("decode", server_id="gen1") == 0.8
+        # times=2 exhausted.
+        assert await inj.maybe_delay("decode", server_id="gen1") == 0.0
+
+    asyncio.run(main())
+    assert slept == [0.8, 0.8]
+    assert inj.fired["decode"] == 2
+    # delay_for consumes charges without sleeping (fake-server seam).
+    inj.arm_delay("decode", 0.5, times=-1)
+    assert inj.delay_for("decode") == 0.5
+    assert inj.delay_for("decode") == 0.5
+    inj.disarm("decode")
+    assert inj.delay_for("decode") == 0.0
+    # Failure arming is independent of delay arming.
+    inj.arm("decode", times=1)
+    with pytest.raises(Exception):
+        inj.maybe_fail("decode")
+
+
+# ------------------------------------------------------- decision core
+
+
+@pytest.mark.autoscale
+def test_core_hysteresis_cooldown_and_bounds():
+    t = [0.0]
+    cfg = AutoscaleConfig(
+        enabled=True, min_servers=1, max_servers=3,
+        up_consecutive=2, down_consecutive=2,
+        scale_up_cooldown_secs=10.0, scale_down_cooldown_secs=20.0,
+        up_utilization=0.8, down_utilization=0.2,
+        queue_high=8.0, queue_low=1.0,
+    )
+    core = AutoscalerCore(cfg, clock=lambda: t[0])
+    hot = FleetSignals(current_size=1, utilization=0.95)
+    # One hot interval is not enough (hysteresis).
+    assert core.observe(hot) is None
+    assert core.target == 1
+    a = core.observe(hot)
+    assert a == {"action": "up", "target": 2,
+                 "reason": a["reason"]} and "utilization" in a["reason"]
+    # Cooldown holds even under sustained pressure.
+    assert core.observe(hot) is None
+    assert core.observe(hot) is None
+    t[0] = 11.0
+    assert core.observe(hot)["target"] == 3
+    # Pinned at max: no further growth, ever.
+    t[0] = 30.0
+    for _ in range(5):
+        assert core.observe(hot) is None
+    assert core.target == 3
+    # Idle fleet scales down after down_consecutive + its own cooldown.
+    idle = FleetSignals(current_size=3, utilization=0.0, queue_depth=0.0)
+    t[0] = 100.0
+    assert core.observe(idle) is None
+    a = core.observe(idle)
+    assert a["action"] == "down" and core.target == 2
+    # A single hot interval resets the down streak.
+    assert core.observe(idle) is None
+    core.observe(hot)
+    t[0] = 200.0
+    assert core.observe(idle) is None  # streak restarted
+    a = core.observe(idle)
+    assert a["action"] == "down" and core.target == 1
+    # Floor: never below min_servers.
+    t[0] = 300.0
+    for _ in range(5):
+        assert core.observe(idle) is None
+    assert core.target == 1
+
+
+@pytest.mark.autoscale
+def test_core_staleness_gate_inhibits_scale_up_and_overload_latches():
+    t = [0.0]
+    cfg = AutoscaleConfig(
+        enabled=True, min_servers=1, max_servers=2,
+        up_consecutive=1, scale_up_cooldown_secs=0.0,
+        up_utilization=0.8,
+    )
+    core = AutoscalerCore(cfg, clock=lambda: t[0])
+    # Saturated BUT the staleness gate is closed: the trainer is the
+    # bottleneck — more generation capacity would only go off-policy.
+    staled = FleetSignals(current_size=1, utilization=1.0, staled=True)
+    assert core.observe(staled) is None
+    assert core.target == 1 and not core.overloaded
+    hot = FleetSignals(current_size=1, utilization=1.0)
+    t[0] = 1.0
+    assert core.observe(hot)["target"] == 2
+    # At max and still saturated: overloaded latches (backpressure on).
+    t[0] = 2.0
+    assert core.observe(FleetSignals(current_size=2, utilization=1.0)) is None
+    assert core.overloaded
+    # Pressure gone: the latch clears.
+    assert core.observe(FleetSignals(current_size=2, utilization=0.0)) is None
+    assert not core.overloaded
+
+
+@pytest.mark.autoscale
+def test_core_wedged_heartbeats_count_against_capacity():
+    cfg = AutoscaleConfig(enabled=True, min_servers=1, max_servers=4)
+    core = AutoscalerCore(cfg, clock=lambda: 0.0)
+    # 3 routable but 2 wedged: effective capacity is 1.
+    core.observe(FleetSignals(current_size=3, stale_heartbeats=2))
+    assert core.target == 1
+
+
+# ------------------------------------------------------- straggler scoring
+
+
+@pytest.mark.autoscale
+def test_straggler_tracker_peer_median_scoring():
+    tr = StragglerTracker(factor=3.0, min_probes=3, slow_sweeps=2,
+                          cordon_sweeps=4, floor_secs=0.002)
+    urls = ["a", "b", "c"]
+    # Below the noise floor nothing is ever slow, however skewed.
+    for _ in range(5):
+        tr.observe("a", 0.0001)
+        tr.observe("b", 0.0001)
+        tr.observe("c", 0.001)
+        assert tr.sweep(urls)["c"] == "ok"
+    tr = StragglerTracker(factor=3.0, min_probes=3, slow_sweeps=2,
+                          cordon_sweeps=4, floor_secs=0.002)
+    verdicts = []
+    for i in range(8):
+        tr.observe("a", 0.010)
+        tr.observe("b", 0.012)
+        tr.observe("c", 0.100)  # ~9x the peer median
+        verdicts.append(tr.sweep(urls)["c"])
+    # Not judged before min_probes; then slow after slow_sweeps
+    # consecutive over-factor sweeps; cordon after cordon_sweeps.
+    assert verdicts[0] == "ok" and verdicts[1] == "ok"
+    assert "slow" in verdicts
+    assert verdicts[-1] == "cordon"
+    assert verdicts.index("slow") < verdicts.index("cordon")
+    # The fast peers are never flagged (peer median excludes self, so
+    # the straggler cannot drag the baseline toward itself).
+    assert tr.sweep(urls)["a"] == "ok" and tr.sweep(urls)["b"] == "ok"
+    # A lone server has no peers to be judged against.
+    solo = StragglerTracker(min_probes=1)
+    solo.observe("x", 5.0)
+    assert solo.sweep(["x"])["x"] == "ok"
+
+
+# ------------------------------------------------------- cordon mechanics
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_cordon_blocks_new_leases_drains_existing_then_uncordon():
+    async def main():
+        mgr = _mgr()
+        u1, u2 = "http://s1:1", "http://s2:2"
+        _add_server(mgr, u1, "gen0")
+        _add_server(mgr, u2, "gen1")
+        # A live lease on s1, then cordon it.
+        resp = await mgr.handle_schedule_request(_Req())
+        lease = json.loads(resp.body.decode())
+        victim = lease["url"]
+        other = u2 if victim == u1 else u1
+        assert mgr.cordon(victim, "preemption notice") is True
+        assert mgr.cordon(victim, "again") is False  # idempotent
+        st = mgr.health[victim]
+        assert st.cordoned and not st.routable
+        # New scheduling avoids the cordoned server entirely...
+        for _ in range(4):
+            r = await mgr.handle_schedule_request(_Req())
+            assert json.loads(r.body.decode())["url"] == other
+        # ...but the existing lease stays valid (drain, don't kill) and
+        # its renewals still work.
+        r = await mgr.handle_renew(_Req({"lease_id": lease["lease_id"]}))
+        assert json.loads(r.body.decode())["ok"]
+        assert mgr._server_draining_load(victim) == 1
+        # The health loop never re-admits a cordoned server.
+        mgr._admit(victim)
+        assert victim not in mgr.servers
+        # Release completes the drain.
+        await mgr.handle_release(_Req({"lease_id": lease["lease_id"]}))
+        assert mgr._server_draining_load(victim) == 0
+        # Uncordon does NOT route immediately — re-admission goes back
+        # through the health gate (probe + weight reconcile).
+        assert mgr.uncordon(victim) is True
+        assert victim not in mgr.servers
+        assert not mgr.health[victim].cordoned
+        mgr._admit(victim)  # the health loop's re-admission path
+        assert victim in mgr.servers
+
+    asyncio.run(main())
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_evicting_a_cordoned_server_still_retires_its_leases():
+    """Deregistration (or death) of a cordoned server must drop its
+    draining leases even though cordon already took it out of routing —
+    the old _evict early-return would have leaked them until TTL."""
+
+    async def main():
+        mgr = _mgr()
+        u1, u2 = "http://s1:1", "http://s2:2"
+        _add_server(mgr, u1, "gen0")
+        _add_server(mgr, u2, "gen1")
+        for _ in range(2):
+            await mgr.handle_schedule_request(_Req())
+        victim = next(u for u, _ in mgr._leases.values())
+        mgr.cordon(victim, "preemption")
+        assert mgr._server_draining_load(victim) >= 1
+        mgr._evict(victim, "deregistered from name_resolve")
+        assert mgr._server_draining_load(victim) == 0
+        assert all(u != victim for u, _ in mgr._leases.values())
+
+    asyncio.run(main())
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_pick_server_deprioritizes_stragglers_until_none_left():
+    async def main():
+        mgr = _mgr()
+        u1, u2 = "http://s1:1", "http://s2:2"
+        _add_server(mgr, u1, "gen0")
+        _add_server(mgr, u2, "gen1")
+        mgr.health[u2].deprioritized = True
+        for _ in range(4):
+            assert mgr._pick_server() == u1
+        # The straggler is still a last resort when it is all we have.
+        mgr.servers.remove(u1)
+        assert mgr._pick_server() == u2
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- straggler e2e(ish)
+
+
+def _fake_health_app(state):
+    """Minimal generation-server stand-in: /health reports the decode
+    EWMA a FaultInjector delay point injects — the same seam the real
+    server's _runner folds injected latency through."""
+    from aiohttp import web
+
+    async def health(req):
+        base = 0.010
+        extra = state["inj"].delay_for("decode",
+                                       server_id=state["server_id"])
+        return web.json_response({
+            "ok": True, "version": 0, "server_id": state["server_id"],
+            "queue_depth": 0, "decode_ewma_secs": base + extra,
+            "ttfc_ewma_secs": 0.0,
+        })
+
+    app = web.Application()
+    app.router.add_get("/health", health)
+    return app
+
+
+async def _start_app(app):
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = network.find_free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner, f"http://127.0.0.1:{port}"
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_injected_decode_latency_deprioritizes_then_cordons(
+        tmp_name_resolve):
+    """THE straggler acceptance path: a server with injected decode
+    latency (FaultInjector delay mode) is deprioritized, then cordoned,
+    purely from the /health-reported EWMAs — and the fleet keeps routing
+    to the healthy peers throughout."""
+    import aiohttp
+
+    inj = FaultInjector()
+    # Every probe of gen2 reports +200ms decode latency: a straggler.
+    inj.arm_delay("decode", 0.200, times=-1,
+                  when=lambda ctx: ctx.get("server_id") == "gen2")
+
+    async def main():
+        mgr = GserverManager(_cfg(
+            straggler_min_probes=2, straggler_slow_sweeps=2,
+            straggler_cordon_sweeps=4, straggler_factor=3.0,
+        ))
+        runners = []
+        urls = {}
+        try:
+            for sid in ("gen0", "gen1", "gen2"):
+                runner, url = await _start_app(
+                    _fake_health_app({"inj": inj, "server_id": sid})
+                )
+                runners.append(runner)
+                urls[sid] = url
+                name_resolve.add(names.gen_servers(EXP, TRIAL, sid), url,
+                                 replace=True)
+            straggler = urls["gen2"]
+            seen = []
+            async with aiohttp.ClientSession() as sess:
+                for _ in range(8):
+                    await mgr.check_fleet(sess)
+                    st = mgr.health.get(straggler)
+                    seen.append(
+                        "cordoned" if (st and st.cordoned)
+                        else "slow" if (st and st.deprioritized)
+                        else "ok"
+                    )
+                    if seen[-1] == "cordoned":
+                        break
+            assert "slow" in seen, seen  # deprioritized first...
+            assert seen[-1] == "cordoned", seen  # ...then cordoned
+            assert seen.index("slow") < len(seen) - 1
+            # Healthy peers were never touched and still route.
+            assert sorted(mgr.servers) == sorted(
+                [urls["gen0"], urls["gen1"]]
+            )
+            assert mgr._pick_server() in (urls["gen0"], urls["gen1"])
+            assert mgr.health[straggler].cordon_reason.startswith(
+                "straggler"
+            )
+        finally:
+            for r in runners:
+                await r.cleanup()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- backpressure
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_capacity_denials_carry_retry_after_only_while_overloaded():
+    async def main():
+        mgr = _mgr(max_servers=1, up_consecutive=1,
+                   backpressure_retry_secs=3.5)
+        _add_server(mgr, "http://s1:1", "gen0")
+        mgr.cfg.max_concurrent_rollouts = 2
+        mgr.running_rollouts = 2  # saturated
+        # Not overloaded yet: plain capacity denial, clients poll at
+        # their default cadence.
+        r = await mgr.handle_allocate_rollout(_Req({"n_samples": 1}))
+        d = json.loads(r.body.decode())
+        assert d == {"allowed": False, "reason": "capacity"}
+        # One tick pins the fleet at max under saturation -> overloaded.
+        mgr._autoscale_tick()
+        assert mgr._overloaded
+        r = await mgr.handle_allocate_rollout(_Req({"n_samples": 1}))
+        d = json.loads(r.body.decode())
+        assert d["reason"] == "capacity" and d["retry_after"] == 3.5
+        # Load clears -> the hint disappears with the latch.
+        mgr.running_rollouts = 0
+        mgr._autoscale_tick()
+        mgr.running_rollouts = 2
+        r = await mgr.handle_allocate_rollout(_Req({"n_samples": 1}))
+        assert "retry_after" not in json.loads(r.body.decode())
+
+    asyncio.run(main())
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_rollout_worker_honors_denial_retry_after(monkeypatch):
+    from areal_tpu.system.rollout_worker import (
+        RolloutWorker,
+        RolloutWorkerConfig,
+    )
+
+    w = RolloutWorker.__new__(RolloutWorker)  # skip dataset/agent init
+    w.cfg = RolloutWorkerConfig()
+    w._mgr_url0 = "http://mgr:1"
+
+    async def fake_post(session, url, payload, timeout_secs=15.0):
+        return {"allowed": False, "reason": "capacity", "retry_after": 2.75}
+
+    w._post_json = fake_post
+    slept = []
+
+    async def fake_sleep(secs):
+        slept.append(secs)
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+
+    async def main():
+        return await w._rollout_one(None, "q0", None, None, None)
+
+    assert asyncio.run(main()) == "retry"
+    assert slept == [2.75]  # the hint, not the 0.5s default
+
+
+# ------------------------------------------------------- plan + executor
+
+
+class _FakeSupervisorCounts:
+    def __init__(self):
+        self.alive = 0
+        self._draining = False
+
+    def alive_count(self, kind):
+        return self.alive
+
+
+@pytest.mark.autoscale
+def test_plan_roundtrip_and_executor_spawns_with_cooldown(tmp_name_resolve):
+    t = [0.0]
+    sup = _FakeSupervisorCounts()
+    spawned = []
+
+    def spawn(sid):
+        spawned.append(sid)
+        sup.alive += 1
+
+    ex = AutoscaleExecutor(EXP, TRIAL, sup, spawn,
+                           spawn_cooldown_secs=5.0, clock=lambda: t[0])
+    assert ex.step() is None  # no plan yet
+    publish_plan(EXP, TRIAL, {"target": 3, "dynamic": 2, "ts": 1.0})
+    assert read_plan(EXP, TRIAL)["dynamic"] == 2
+    assert ex.step() == "dyn1"
+    # Cooldown: the second spawn waits even though the plan wants 2.
+    assert ex.step() is None
+    t[0] = 6.0
+    assert ex.step() == "dyn2"
+    t[0] = 20.0
+    assert ex.step() is None  # satisfied
+    assert spawned == ["dyn1", "dyn2"]
+    # A removed (crash-looped) server drops the count -> replaced with a
+    # FRESH id, never a reused one.
+    sup.alive = 1
+    assert ex.step() == "dyn3"
+    # Draining supervisor: the executor stands down.
+    sup.alive = 0
+    sup._draining = True
+    t[0] = 40.0
+    assert ex.step() is None
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_autoscale_tick_publishes_plan_and_scale_down_cordons_dynamic(
+        tmp_name_resolve, monkeypatch):
+    async def main():
+        mgr = _mgr(min_servers=1, max_servers=3, up_consecutive=1,
+                   scale_up_cooldown_secs=0.0)
+        _add_server(mgr, "http://s1:1", "gen0")
+        mgr.cfg.max_concurrent_rollouts = 4
+        mgr.running_rollouts = 4  # hot
+        mgr._autoscale_tick()
+        plan = read_plan(EXP, TRIAL)
+        # Target grew past the 1 alive baseline -> 1 dynamic wanted.
+        assert plan["target"] == 2 and plan["dynamic"] == 1
+        assert mgr.autoscaler.target == 2
+        # The dynamic server joins; now force a scale-down and verify the
+        # victim choice (dynamic before baseline) + the commanded exit.
+        _add_server(mgr, "http://s2:2", "dyn1")
+        mgr.running_rollouts = 0
+        mgr.autoscaler.target = 1
+        exits = []
+        monkeypatch.setattr(
+            mgr, "_command_server_exit",
+            lambda sid: exits.append(sid) or True,
+        )
+        mgr._autoscale_tick()
+        st = mgr.health["http://s2:2"]
+        assert st.cordoned and st.cordon_reason.startswith("scale-down")
+        assert "http://s2:2" not in mgr.servers
+        await mgr._drain_cordoned()  # no leases -> drained immediately
+        assert exits == ["dyn1"]
+        assert st.exit_commanded
+        assert read_plan(EXP, TRIAL)["dynamic"] == 0
+
+    asyncio.run(main())
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_scale_down_reclaims_cordoned_baseline_before_spawning(
+        tmp_name_resolve):
+    async def main():
+        mgr = _mgr(min_servers=1, max_servers=3)
+        _add_server(mgr, "http://s1:1", "gen0")
+        _add_server(mgr, "http://s2:2", "gen1")
+        mgr.autoscaler.target = 1
+        mgr._autoscale_tick()  # cordon one baseline for scale-down
+        cordoned = [u for u, st in mgr.health.items() if st.cordoned]
+        assert len(cordoned) == 1
+        # Pressure returns: reclaim the healthy cordoned baseline (it
+        # still holds near-current weights) instead of spawning cold.
+        mgr.autoscaler.target = 2
+        mgr._autoscale_tick()
+        assert not mgr.health[cordoned[0]].cordoned
+        assert read_plan(EXP, TRIAL)["dynamic"] == 0
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------- flapping server
+
+
+class _FakeProc:
+    _next_pid = [2000]
+
+    def __init__(self):
+        _FakeProc._next_pid[0] += 1
+        self.pid = _FakeProc._next_pid[0]
+        self._alive = True
+        self.exitcode = None
+
+    def is_alive(self):
+        return self._alive
+
+    def die(self, code):
+        self._alive = False
+        self.exitcode = code
+
+    def join(self, timeout=None):
+        pass
+
+    def terminate(self):
+        self.die(-15)
+
+    def kill(self):
+        self.die(-9)
+
+
+@pytest.mark.autoscale
+@pytest.mark.chaos
+def test_flapping_server_trips_breaker_removed_not_escalated(
+        tmp_name_resolve):
+    """ISSUE 11 satellite: a generation server that crashes repeatedly
+    inside the crash-loop window trips the circuit breaker and is
+    PERMANENTLY removed from the fleet — no SupervisorEscalation, no
+    whole-run relaunch — and the executor replaces it (fresh spec, fresh
+    id) within the plan's bounds."""
+    from areal_tpu.system.supervisor import (
+        RestartPolicy,
+        Supervisor,
+        WorkerSpec,
+    )
+
+    t = [0.0]
+    sup = Supervisor(EXP, TRIAL,
+                     policy=RestartPolicy(max_restarts=2, window_secs=100.0,
+                                          backoff_base_secs=0.1,
+                                          backoff_max_secs=0.1),
+                     clock=lambda: t[0])
+    sup._make_proc = lambda spec, incarnation: _FakeProc()
+    sup.spawn(WorkerSpec(name="genserver_dyn1", kind="gen_server",
+                         target=lambda: None, required=False,
+                         expendable=True))
+    entry = sup._entries["genserver_dyn1"]
+    assert sup.alive_count("gen_server") == 1
+    # Crash -> respawn (x2), then the breaker trips on the third death.
+    for _ in range(2):
+        entry.proc.die(1)
+        sup.check()  # classify + schedule respawn
+        t[0] += 0.2
+        sup.check()  # execute the respawn
+        assert entry.proc.is_alive()
+    entry.proc.die(1)
+    sup.check()  # breaker trips: MUST NOT raise SupervisorEscalation
+    assert entry.done
+    assert sup.alive_count("gen_server") == 0
+    assert sup.restart_counts.get("gen_server") == 2
+    # The autoscaler replaces the removed server within bounds.
+    publish_plan(EXP, TRIAL, {"target": 2, "dynamic": 1, "ts": 1.0})
+    spawned = []
+    ex = AutoscaleExecutor(EXP, TRIAL, sup, spawned.append,
+                           clock=lambda: t[0])
+    ex.step()
+    assert spawned == ["dyn1"]  # executor ids are its own sequence
+
+    # A NON-expendable stateless worker still escalates on a crash loop
+    # (the pre-existing contract is untouched).
+    from areal_tpu.system.supervisor import SupervisorEscalation
+
+    sup2 = Supervisor(EXP, TRIAL,
+                      policy=RestartPolicy(max_restarts=1,
+                                           window_secs=100.0,
+                                           backoff_base_secs=0.1),
+                      clock=lambda: t[0])
+    sup2._make_proc = lambda spec, incarnation: _FakeProc()
+    sup2.spawn(WorkerSpec(name="rollout0", kind="rollout",
+                          target=lambda: None))
+    e2 = sup2._entries["rollout0"]
+    e2.proc.die(1)
+    sup2.check()
+    t[0] += 0.2
+    sup2.check()
+    e2.proc.die(1)
+    with pytest.raises(SupervisorEscalation):
+        sup2.check()
+
+
+# ------------------------------------------------------- live e2e (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.autoscale
+@pytest.mark.chaos
+@pytest.mark.timeout(900)
+def test_autoscale_e2e_load_spike_then_preemption_drain(tmp_path):
+    """THE ISSUE 11 acceptance run: a live launcher-supervised async-PPO
+    experiment under a synthetic load spike (tiny rollout quota, eager
+    thresholds) GROWS the fleet — the manager's plan makes the executor
+    spawn dynamic servers that join via discovery + streamed-weight
+    admission (no checkpoint round-trip) — then a simulated preemption
+    notice cordons two servers, which drain with zero lost rollouts
+    (clients fail over), the run completes its full step count, and the
+    merged Prometheus scrape shows nonzero autoscale scale-up and
+    scale-down counters plus the target/current fleet-size gauges."""
+    import threading
+    import time as _time
+    import urllib.request
+
+    from test_fault_tolerance import (
+        _build_supervised_async_cfg,
+        _wait_master_step,
+    )
+
+    from areal_tpu.apps.launcher import LocalLauncher
+    from areal_tpu.base import network as _network
+    from areal_tpu.experiments import common as C
+
+    port = _network.find_free_port()
+    cfg = _build_supervised_async_cfg(tmp_path, "autoscl",
+                                      benchmark_steps=40, http_port=port)
+    # Synthetic load spike: a 4-slot rollout quota saturates instantly,
+    # and eager thresholds/cooldowns scale within a few 0.5s intervals.
+    cfg.autoscale.enabled = True
+    cfg.autoscale.min_servers = 1
+    cfg.autoscale.max_servers = 3
+    cfg.autoscale.interval_secs = 0.5
+    cfg.autoscale.up_consecutive = 2
+    cfg.autoscale.scale_up_cooldown_secs = 1.0
+    cfg.autoscale.up_utilization = 0.75
+    cfg.autoscale.drain_timeout_secs = 20.0
+    cfg.autoscale.straggler_defense = False  # this run tests elasticity
+    C.setup_name_resolve(cfg)
+    launcher = LocalLauncher(cfg)
+    result, errs = {}, []
+
+    def _run():
+        try:
+            result.update(launcher.run())
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errs.append(e)
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    mgr_url = None
+    try:
+        _wait_master_step("autoscl", "t0", 1)
+        mgr_url = name_resolve.wait(
+            names.gen_server_manager("autoscl", "t0"), timeout=60
+        )
+
+        def fleet():
+            with urllib.request.urlopen(
+                f"{mgr_url}/metrics.json", timeout=10
+            ) as r:
+                return json.loads(r.read().decode())
+
+        # ---- scale-up: the fleet grows beyond the 1-server baseline,
+        # and the joiner is a supervisor-spawned dynamic server admitted
+        # at the CURRENT weight version (streamed reconcile; with
+        # weight_sync.transport=stream no realloc checkpoint exists to
+        # round-trip through).
+        deadline = _time.monotonic() + 240
+        grown = None
+        while _time.monotonic() < deadline and t.is_alive():
+            m = fleet()
+            dyn = [
+                (u, st) for u, st in m["fleet"].items()
+                if st["server_id"].startswith("dyn") and st["routable"]
+            ]
+            if m["healthy_servers"] >= 2 and dyn:
+                grown = m
+                break
+            _time.sleep(0.5)
+        assert grown is not None, "fleet never scaled up"
+        assert grown["autoscale"]["target_size"] >= 2
+        for u, st in grown["fleet"].items():
+            if st["server_id"].startswith("dyn") and st["routable"]:
+                assert st["acked_version"] == grown["version"]
+        assert any(
+            n.startswith("genserver_dyn")
+            for n in launcher.supervisor._entries
+        )
+
+        # ---- simulated preemption notice on two servers -> cordon.
+        m = fleet()
+        routable = [u for u, st in m["fleet"].items() if st["routable"]]
+        assert len(routable) >= 2
+        victims = routable[:2]
+        for v in victims:
+            body = json.dumps(
+                {"url": v, "reason": "preemption notice"}
+            ).encode()
+            req = urllib.request.Request(
+                f"{mgr_url}/cordon", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert json.loads(r.read().decode())["ok"]
+
+        # Both drain (leases released or failed over), within the budget.
+        deadline = _time.monotonic() + 120
+        drained = False
+        while _time.monotonic() < deadline and t.is_alive():
+            m = fleet()
+            states = [m["fleet"].get(v) for v in victims]
+            if all(
+                st is None or (st["cordoned"] and st["draining"] == 0)
+                for st in states
+            ):
+                drained = True
+                break
+            _time.sleep(0.5)
+        assert drained, "cordoned servers never drained"
+
+        # ---- the merged scrape carries the autoscale counters/gauges.
+        scrape = None
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline and t.is_alive():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as r:
+                    body = r.read().decode()
+                if ("areal_autoscale_scale_up_total" in body
+                        and "areal_autoscale_scale_down_total" in body):
+                    scrape = body
+                    break
+            except Exception:  # noqa: BLE001 — aggregator busy
+                pass
+            _time.sleep(0.3)
+        assert scrape is not None, "autoscale metrics never scraped"
+
+        def _total(name):
+            return sum(
+                float(ln.rpartition(" ")[2])
+                for ln in scrape.splitlines()
+                if ln.startswith(name) and not ln.startswith("#")
+            )
+
+        assert _total("areal_autoscale_scale_up_total") >= 1
+        assert _total("areal_autoscale_scale_down_total") >= 2
+        assert "areal_autoscale_target_size" in scrape
+        assert "areal_autoscale_current_size" in scrape
+
+        # ---- zero lost rollouts: the run completes its full step count
+        # (every admitted prompt either finished or failed over — an
+        # abandoned rollout would starve the master short of 40 steps).
+        t.join(timeout=600)
+        assert not t.is_alive(), "experiment never completed"
+        assert not errs, errs
+        assert result["steps"] == 40
+    finally:
+        launcher.request_drain()
+        t.join(timeout=30)
+        if launcher.supervisor is not None:
+            launcher.supervisor.shutdown(timeout=10.0, orderly=False)
+

@@ -126,6 +126,43 @@ def test_mode_ray_fails_at_config_parse_time():
         CA.validate_config(c)
 
 
+def test_name_resolve_etcd3_fails_at_config_parse_time():
+    """ISSUE 11 satellite: no Etcd3NameRecordRepo exists, so
+    type='etcd3' must fail with guidance while the operator is still at
+    the command line (mirroring the mode=ray fix) instead of a
+    NotImplementedError after workers spawned."""
+    cfg = PPOMATHConfig()
+    CA.apply_overrides(cfg, ["cluster.name_resolve.type=etcd3"])
+    with pytest.raises(CA.ConfigError, match="etcd3"):
+        CA.validate_config(cfg)
+    # the implemented backends validate clean
+    for t in ("memory", "nfs"):
+        c = PPOMATHConfig()
+        CA.apply_overrides(c, [f"cluster.name_resolve.type={t}"])
+        CA.validate_config(c)
+
+
+def test_autoscale_config_validates_at_parse_time():
+    """Bad autoscale bounds/thresholds would flap the fleet (or crash
+    the manager's loop) — they fail at validate_config instead."""
+    for bad, match in [
+        ("autoscale.min_servers=0", "min_servers"),
+        ("autoscale.max_servers=1 autoscale.min_servers=2", "max_servers"),
+        ("autoscale.interval_secs=0", "interval_secs"),
+        ("autoscale.down_utilization=0.9", "thresholds"),
+        ("autoscale.straggler_factor=0.5", "straggler_factor"),
+    ]:
+        cfg = PPOMATHConfig()
+        CA.apply_overrides(cfg, ["autoscale.enabled=true"] + bad.split())
+        with pytest.raises(CA.ConfigError, match=match):
+            CA.validate_config(cfg)
+    # defaults validate clean, enabled or not
+    cfg = PPOMATHConfig()
+    CA.apply_overrides(cfg, ["autoscale.enabled=true"])
+    CA.validate_config(cfg)
+    CA.validate_config(PPOMATHConfig())
+
+
 def test_invalid_serving_buckets_fail_at_config_parse_time():
     """Serving bucket configs that would crash every spawned generation
     server's __init__ (row_buckets below the batch size, shape sets over

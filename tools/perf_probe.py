@@ -39,10 +39,27 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       per-worker heartbeat ages +
                                       incarnations (name-resolve
                                       liveness leases), the drain phase,
-                                      and the supervisor restart /
-                                      crash-loop counters from the
-                                      merged Prometheus scrape
+                                      the autoscale plan (target/dynamic
+                                      fleet size, overload flag), the
+                                      per-server fleet map (routable /
+                                      cordoned / deprioritized,
+                                      draining lease counts), and the
+                                      supervisor restart / crash-loop
+                                      counters from the merged
+                                      Prometheus scrape
                                       (docs/fault_tolerance.md)
+  cordon <exp> <trial> <server> [why] preemption-notice hook: cordon one
+                                      generation server (server_id like
+                                      gen1/dyn2, or its url) — it stops
+                                      receiving leases, inflight
+                                      rollouts drain or fail over, and
+                                      a drained dynamic server exits
+                                      via WorkerControl
+                                      (docs/fault_tolerance.md
+                                      §Autoscaling)
+  uncordon <exp> <trial> <server>     lift a cordon; the server
+                                      re-admits through the health gate
+                                      (probe + weight reconcile)
   drain <exp> <trial>                 graceful preemption drain of a
                                       LIVE run: pause the rollout fleet,
                                       dump an out-of-band recover
@@ -307,6 +324,45 @@ def fleet_status(experiment: str, trial: str) -> None:
     except Exception:  # noqa: BLE001 — no drain ever requested
         print("drain phase: none")
     try:
+        plan = _json.loads(name_resolve.get(
+            names.autoscale_plan(experiment, trial)
+        ))
+        print(f"autoscale plan: target={plan.get('target')} "
+              f"dynamic={plan.get('dynamic')} "
+              f"overloaded={plan.get('overloaded')}")
+    except Exception:  # noqa: BLE001 — autoscale disabled / no plan yet
+        print("autoscale plan: none (autoscale disabled?)")
+    # Per-server fleet map from the manager (jax-free JSON endpoint):
+    # who is routable / cordoned / deprioritized, and what is draining.
+    try:
+        mgr = name_resolve.get(names.gen_server_manager(experiment, trial))
+        with urllib.request.urlopen(f"{mgr.rstrip('/')}/metrics.json",
+                                    timeout=10) as r:
+            m = _json.loads(r.read().decode())
+        asc = m.get("autoscale") or {}
+        print(f"fleet: {m.get('healthy_servers')}/{m.get('known_servers')} "
+              f"routable, {asc.get('cordoned', 0)} cordoned"
+              + (f", target {asc.get('target_size')}"
+                 if asc.get("enabled") else ""))
+        for u, st in sorted((m.get("fleet") or {}).items()):
+            state = ("cordoned" if st.get("cordoned")
+                     else "routable" if st.get("routable")
+                     else "evicted")
+            extra = []
+            if st.get("server_id"):
+                extra.append(st["server_id"])
+            if st.get("deprioritized"):
+                extra.append("deprioritized(straggler)")
+            if st.get("cordoned"):
+                extra.append(f"reason={st.get('cordon_reason', '?')}")
+                extra.append(f"draining={st.get('draining', 0)}")
+            if st.get("evicted_reason") and state == "evicted":
+                extra.append(st["evicted_reason"])
+            print(f"  {u}  {state}" + ("  [" + ", ".join(extra) + "]"
+                                       if extra else ""))
+    except Exception as e:  # noqa: BLE001 — manager down
+        print(f"fleet map: manager unreachable ({e})")
+    try:
         url = name_resolve.get(names.telemetry_http(experiment, trial))
         with urllib.request.urlopen(f"{url.rstrip('/')}/metrics",
                                     timeout=10) as r:
@@ -322,6 +378,51 @@ def fleet_status(experiment: str, trial: str) -> None:
     except Exception:  # noqa: BLE001 — telemetry off / no http port
         print("supervisor metrics: merged scrape unavailable "
               "(telemetry disabled or no http_port)")
+
+
+def _manager_url(experiment: str, trial: str) -> str:
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        return name_resolve.get(names.gen_server_manager(experiment, trial))
+    except Exception as e:  # noqa: BLE001 — run down / wrong root
+        sys.exit(f"cannot resolve the gserver manager for "
+                 f"{experiment}/{trial}: {e}\n(is the run up, and "
+                 f"AREAL_NAME_RESOLVE_ROOT pointing at its store?)")
+
+
+def cordon(experiment: str, trial: str, server: str,
+           reason: str = "operator request", un: bool = False) -> None:
+    """Cordon (or uncordon) one generation server of a live run — the
+    operator's preemption-notice hook (docs/fault_tolerance.md
+    §Autoscaling). ``server`` is a server_id (e.g. gen1, dyn2) or a full
+    http url; the cordoned server stops receiving leases, its inflight
+    rollouts drain, and the autoscale loop reaps a drained dynamic
+    server via a WorkerControl-commanded exit."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = _manager_url(experiment, trial)
+    key = "url" if server.startswith("http") else "server_id"
+    body = _json.dumps(
+        {key: server, "reason": reason}
+    ).encode()
+    verb = "uncordon" if un else "cordon"
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/{verb}", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            d = _json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        sys.exit(f"{verb} {server}: manager said {e.code} "
+                 f"({e.read().decode()[:200]})")
+    print(_json.dumps(d, indent=2, sort_keys=True))
+    if not un and d.get("ok"):
+        print(f"{d.get('url')} cordoned; {d.get('draining', 0)} leases "
+              f"draining — watch `fleet-status {experiment} {trial}`")
 
 
 def drain(experiment: str, trial: str) -> None:
@@ -502,12 +603,18 @@ def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
                                    "flight-dump", "packfill", "blocksweep",
                                    "profile-trigger", "profile-status",
-                                   "fleet-status", "drain"):
+                                   "fleet-status", "drain", "cordon",
+                                   "uncordon"):
         return False
     cmd = argv[0]
     try:
         if cmd == "fleet-status":
             fleet_status(argv[1], argv[2])
+        elif cmd == "cordon":
+            cordon(argv[1], argv[2], argv[3],
+                   " ".join(argv[4:]) or "operator request")
+        elif cmd == "uncordon":
+            cordon(argv[1], argv[2], argv[3], un=True)
         elif cmd == "drain":
             drain(argv[1], argv[2])
         elif cmd == "scrape":
