@@ -1,3 +1,7 @@
 # Importing the package registers all built-in agents/envs (the reference
 # does this in realhf/impl/__init__.py with its register_* calls).
-from areal_tpu.agents import math_multi_turn, math_single_step  # noqa: F401
+from areal_tpu.agents import (  # noqa: F401
+    code_single_step,
+    math_multi_turn,
+    math_single_step,
+)

@@ -18,7 +18,7 @@ from areal_tpu.api.agent import Agent, EnvironmentService
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import register_agent, register_env
 from areal_tpu.base import logging
-from areal_tpu.rewards.client import batch_reward
+from areal_tpu.rewards.client import abatch_reward, task_from_record
 
 logger = logging.getLogger("agents.math")
 
@@ -34,16 +34,11 @@ class MathCodeSingleStepEnv(EnvironmentService):
         # ids carry "@"-separated suffixes (group index, epoch-pass tag);
         # the dataset key is everything before the first "@".
         info = self.id2info.get(str(qid).split("@", 1)[0], {})
-        kind = info.get("task", "math")
-        tasks = []
-        for t in texts:
-            task = {"task": kind, "generated": t}
-            if kind == "code":
-                task["input_output"] = info.get("input_output", "{}")
-            else:
-                task["solutions"] = info.get("solutions", [])
-            tasks.append(task)
-        scores = await asyncio.to_thread(batch_reward, tasks)
+        tasks = [task_from_record(info, t) for t in texts]
+        # Real async entrypoint (rewards/client.py): grading — local,
+        # legacy-domain, or reward-service fanout — never blocks the
+        # rollout event loop on a dedicated grading thread.
+        scores = await abatch_reward(tasks)
         return None, scores, True, {}
 
 

@@ -19,7 +19,7 @@ from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model import Model, ModelInterface, register_interface
 from areal_tpu.base import logging
 from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl
-from areal_tpu.rewards.client import batch_reward
+from areal_tpu.rewards.client import batch_reward, task_from_record
 
 logger = logging.getLogger("algorithms.reward")
 
@@ -64,13 +64,11 @@ class MultiTaskRewardInterface(ModelInterface):
             gen_tokens = data.data["packed_input_ids"][span][pm[span] == 0]
             text = tok.decode(gen_tokens) if tok is not None else ""
             info = self._lookup(data.ids[i])
+            # kind falls back to the sample's task_ids when the record is
+            # missing; the shared builder handles the per-kind fields
+            # (input_output + language for code, solutions otherwise).
             kind = info.get("task") or RL_TASKS[int(task_ids[i])]
-            task: Dict[str, Any] = {"task": kind, "generated": text}
-            if kind == "code":
-                task["input_output"] = info.get("input_output", "{}")
-            else:
-                task["solutions"] = info.get("solutions", [])
-            tasks.append(task)
+            tasks.append(task_from_record({**info, "task": kind}, text))
         scores = np.asarray(batch_reward(tasks), np.float32)
         if self.check_verifier_status and float(np.abs(scores).sum()) == 0:
             logger.warning(

@@ -36,6 +36,7 @@ from areal_tpu.api.train_config import (  # noqa: F401
     ExperimentSaveEvalControl,
     FaultToleranceConfig,
     OptimizerConfig,
+    RewardServiceConfig,
     ServingConfig,
     TelemetryConfig,
     WeightSyncConfig,
@@ -150,6 +151,13 @@ class AutomaticEvaluatorConfig:
     eval_job_image: Optional[str] = None
     initial_checkpoint_path: Optional[str] = None
     prompt_type: str = "math-cot"
+    # pass@k sampling evaluation (apps/eval_ckpt.py, docs/rewards.md):
+    # k>1 draws k temperature-sampled generations per prompt and the
+    # evaluator publishes pass@1/pass@k/pass^k per task kind to
+    # tensorboard for every saved checkpoint; k=1 keeps the legacy
+    # greedy single-sample accuracy.
+    eval_k: int = 1
+    temperature: float = 0.6
 
 
 # --------------------------------------------------------------------------
@@ -223,6 +231,14 @@ class BaseExperimentConfig:
     # backpressure) and the launcher-side spawn executor.
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig
+    )
+    # Sandboxed reward service (docs/rewards.md): off by default —
+    # `reward_service.enabled=true` spawns the reward-worker fleet and
+    # switches rollout/trainer reward grading to HTTP fanout with retry
+    # and local-fallback degradation; disabled = exact legacy local
+    # grading, bit-identical outputs.
+    reward_service: RewardServiceConfig = dataclasses.field(
+        default_factory=RewardServiceConfig
     )
     torch_cache_mysophobia: bool = False  # parity no-op (no torch allocator)
     cache_clear_freq: Optional[int] = 10
@@ -464,6 +480,41 @@ def validate_config(cfg) -> None:
             raise ConfigError(
                 f"serving.min_rollout_share={share} must be in [0, 1] "
                 f"(fraction of each batch reserved for rollout traffic)"
+            )
+    rs = getattr(cfg, "reward_service", None)
+    if rs is not None and getattr(rs, "enabled", False):
+        if rs.n_workers < 1:
+            raise ConfigError(
+                f"reward_service.n_workers={rs.n_workers} must be >= 1 "
+                f"(an enabled fleet needs at least one sandbox worker)"
+            )
+        for knob in ("max_inflight", "pool_size", "max_concurrency"):
+            if getattr(rs, knob) < 1:
+                raise ConfigError(
+                    f"reward_service.{knob}={getattr(rs, knob)} must be >= 1"
+                )
+        for knob in ("grade_timeout_secs", "request_timeout_secs"):
+            if getattr(rs, knob) <= 0:
+                raise ConfigError(
+                    f"reward_service.{knob}={getattr(rs, knob)} must be > 0 "
+                    f"(a reward grade must have a finite wall budget)"
+                )
+        if not rs.languages:
+            raise ConfigError(
+                "reward_service.languages is empty: an enabled fleet that "
+                "grades no language returns 0.0 for every code task — "
+                "list at least one of rewards/code_verify.py GRADERS "
+                "(e.g. reward_service.languages=python)"
+            )
+        from areal_tpu.rewards.code_verify import GRADERS
+
+        unknown = [l for l in rs.languages if l not in GRADERS]
+        if unknown:
+            raise ConfigError(
+                f"reward_service.languages={rs.languages}: no grader is "
+                f"registered for {unknown} (available: "
+                f"{', '.join(sorted(GRADERS))}; new languages register in "
+                f"rewards/code_verify.py GRADERS)"
             )
 
 

@@ -149,6 +149,64 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class RewardServiceConfig:
+    """Sandboxed reward service — the sixth worker kind
+    (system/reward_worker.py + rewards/service.py, docs/rewards.md).
+
+    Off by default: with ``enabled=False`` reward grading runs exactly the
+    legacy local path (rewards/math_verify.py / rewards/code_verify.py on
+    the calling worker's thread pool) — bit-identical outputs, no sockets.
+    Enabled, the launcher spawns ``n_workers`` CPU reward workers; each
+    hosts an HTTP sandbox fleet member that grades math/code tasks in
+    rlimit-guarded subprocess pools, and the rollout/trainer reward paths
+    fan out to them (rewards/client.py) with bounded in-flight
+    concurrency, capped-exponential retry across surviving replicas, and
+    partial-batch degradation to local grading when the fleet is
+    unreachable (parity: the reference's 3k-LoC functioncall service,
+    ``functioncall/base/call.py:81-235``)."""
+
+    enabled: bool = False
+    # Sandbox fleet size (one reward worker process each; CPU-only).
+    n_workers: int = 1
+    # Fixed port of worker 0 (workers i bind port+i); 0 = random ports,
+    # discovered through name_resolve either way.
+    port: int = 0
+    # ---- worker-side grading ----
+    # Concurrent grading slots per worker, clamped to pool_size at
+    # runtime (an admitted task must start grading immediately so the
+    # wall budget never times executor-queue wait).
+    max_inflight: int = 16
+    # Grader threads per worker; each code grade additionally runs its
+    # own rlimit-guarded subprocess (rewards/code_verify.py).
+    pool_size: int = 8
+    # Server-side wall budget per task: a grade that overruns returns a
+    # 0.0 verdict with verdict="timeout" and bumps reward_timeouts_total.
+    # Bounds a WEDGED grader: code tasks floor at their legal worst case
+    # (per-case timeout x sampled cases) so slow-but-correct programs
+    # never get spurious timeout verdicts (rewards/service.py).
+    grade_timeout_secs: float = 30.0
+    # Languages this fleet will grade; tasks in other languages return a
+    # 0.0 verdict with verdict="unsupported_language" (per-task dispatch:
+    # rewards/code_verify.py GRADERS — C++/bash slot in there).
+    languages: List[str] = dataclasses.field(
+        default_factory=lambda: ["python"]
+    )
+    # ---- client-side fanout (rewards/client.py) ----
+    # In-flight request cap across one batch fanout.
+    max_concurrency: int = 64
+    # Per-task HTTP timeout (covers queue wait + grading on the worker).
+    request_timeout_secs: float = 120.0
+    # Retries per task across surviving replicas before degrading.
+    max_retries: int = 2
+    retry_base_delay_secs: float = 0.2
+    retry_max_delay_secs: float = 2.0
+    # Degrade to local grading when the fleet is unreachable / a task's
+    # retry budget is exhausted. False: failed tasks score 0.0 instead of
+    # executing untrusted code in the calling process.
+    local_fallback: bool = True
+
+
+@dataclasses.dataclass
 class AutoscaleConfig:
     """Elastic generation-fleet autoscaling (system/autoscaler.py,
     docs/fault_tolerance.md §Autoscaling).

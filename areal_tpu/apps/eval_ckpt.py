@@ -1,30 +1,58 @@
-"""Offline checkpoint evaluation harness.
+"""Offline checkpoint evaluation harness — pass@k over mixed math+code.
 
 Parity target: the reference's ``evaluation/`` harness as driven by
 ``realhf/scheduler/evaluator.py`` (one subprocess per saved checkpoint:
 generate on a benchmark set, grade, emit scores). The reference vendors a
 51k-LoC latex2sympy stack and uses vLLM; here the same framework that
-trains also evaluates: checkpoints load through ``models/hf.py``, greedy
-(or sampled) generation runs through ``models/generate.py`` on whatever
-platform this process owns, and grading uses ``rewards/math_verify.py``.
+trains also evaluates: checkpoints load through ``models/hf.py``,
+generation runs through ``models/generate.py`` on whatever platform this
+process owns, and grading dispatches per task kind through
+``rewards/client.py`` (math_verify / the code sandbox — or the reward
+fleet, when one is configured).
+
+``--k 1`` (default) is the legacy greedy single-sample accuracy.
+``--k N`` draws N temperature-sampled generations per prompt and reports
+the unbiased pass@k estimator (Chen et al. 2021: 1 - C(n-c,k)/C(n,k))
+plus pass^k (all k draws correct: C(c,k)/C(n,k)) — overall and per task
+kind, so a mixed math+code eval set yields ``math/pass@1``,
+``code/pass@4``, ... in one run (docs/rewards.md §pass@k).
 
 Usage:
     python -m areal_tpu.apps.eval_ckpt --ckpt <hf_dir> --dataset <jsonl> \
-        --output scores.json [--max-gen-tokens 512] [--mock-tokenizer]
+        --output scores.json [--k 4] [--temperature 0.6] \
+        [--max-gen-tokens 512] [--mock-tokenizer]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from areal_tpu.base import logging
 
 logger = logging.getLogger("apps.eval")
+
+
+def pass_at_k(n: int, c: int, k: int) -> float:
+    """Unbiased pass@k from n samples with c correct (Codex paper eq. 1):
+    1 - C(n-c, k) / C(n, k). Requires n >= k."""
+    if n - c < k:
+        return 1.0
+    return 1.0 - math.comb(n - c, k) / math.comb(n, k)
+
+
+def pass_hat_k(n: int, c: int, k: int) -> float:
+    """pass^k — the probability that ALL k independent draws are correct:
+    C(c, k) / C(n, k). The metric that matters when every sample must be
+    right (agentic chains), as opposed to best-of-k."""
+    if c < k:
+        return 0.0
+    return math.comb(c, k) / math.comb(n, k)
 
 
 def evaluate_checkpoint(
@@ -34,6 +62,12 @@ def evaluate_checkpoint(
     batch_size: int = 16,
     mock_tokenizer: bool = False,
     limit: Optional[int] = None,
+    k: int = 1,
+    temperature: float = 0.6,
+    seed: int = 0,
+    service_experiment: str = "",
+    service_trial: str = "",
+    service_config: Optional[Dict] = None,
 ) -> dict:
     import jax
 
@@ -41,8 +75,31 @@ def evaluate_checkpoint(
     from areal_tpu.datasets.jsonl import load_jsonl
     from areal_tpu.models import generate as G
     from areal_tpu.models import hf as hfmod
-    from areal_tpu.rewards.math_verify import verify_math
+    from areal_tpu.rewards.client import batch_reward, task_from_record
 
+    if k < 1:
+        raise ValueError(f"k={k} must be >= 1")
+    if service_experiment:
+        # Grade over the live sandbox reward fleet (docs/rewards.md):
+        # this subprocess discovers the workers through name_resolve
+        # (AREAL_NAME_RESOLVE_ROOT, exported by the evaluator), so
+        # generated code never executes in the eval process while a
+        # fleet is up. ``service_config`` carries the OPERATOR'S knobs
+        # (the evaluator serializes the run's RewardServiceConfig) —
+        # in particular local_fallback=false must hold here too: an
+        # eval process is exactly as wrong a place for untrusted code
+        # as a rollout worker.
+        import dataclasses as _dc
+
+        from areal_tpu.api.train_config import RewardServiceConfig
+        from areal_tpu.rewards.client import configure_service
+
+        known = {f.name for f in _dc.fields(RewardServiceConfig)}
+        kw = {kk: v for kk, v in (service_config or {}).items()
+              if kk in known}
+        kw["enabled"] = True
+        configure_service(RewardServiceConfig(**kw),
+                          service_experiment, service_trial)
     cfg, params = hfmod.load_hf_checkpoint(ckpt_dir)
     if mock_tokenizer:
         from areal_tpu.base.testing import MockTokenizer
@@ -57,37 +114,83 @@ def evaluate_checkpoint(
         records = records[:limit]
     eos = getattr(tok, "eos_token_id", None) or 1
     pad = getattr(tok, "pad_token_id", None) or eos
-    gconfig = GenerationHyperparameters(greedy=True)
-    n_correct, n_total = 0, 0
+    # k=1 keeps the legacy deterministic greedy eval; k>1 is the
+    # temperature-sampled estimator (greedy k-way would draw k identical
+    # samples and estimate nothing).
+    gconfig = GenerationHyperparameters(
+        greedy=(k == 1), temperature=temperature
+    )
+    # n_correct per record, task kind per record
+    per_rec_correct: List[int] = [0] * len(records)
+    kinds: List[str] = [r.get("task", "math") for r in records]
     t0 = time.time()
+    # Tokenization/padding is draw-invariant — encode each batch once,
+    # reuse the padded arrays across all k draws.
+    batches = []
     for i in range(0, len(records), batch_size):
         chunk = records[i : i + batch_size]
         prompt_list: List[List[int]] = [
             list(map(int, tok.encode(r["prompt"]))) for r in chunk
         ]
-        prompts, plens = G.pad_prompts(prompt_list, pad)
-        out = G.generate_batch(
-            params, cfg, prompts, plens,
-            key=jax.random.PRNGKey(0),
-            gconfig=gconfig,
-            max_new_tokens=max_gen_tokens,
-            eos_token_id=eos,
-            pad_token_id=pad,
-        )
-        out_ids = np.asarray(out["output_ids"])
-        out_lens = np.asarray(out["output_lens"])
-        for rec, toks, n in zip(chunk, out_ids, out_lens):
-            text = tok.decode(list(map(int, toks[: int(n)])))
-            score = verify_math(text, rec.get("solutions", []))
-            n_correct += int(score > 0)
-            n_total += 1
-    return {
+        batches.append((i, chunk, G.pad_prompts(prompt_list, pad)))
+    for draw in range(k):
+        key = jax.random.PRNGKey(seed + draw)
+        for i, chunk, (prompts, plens) in batches:
+            out = G.generate_batch(
+                params, cfg, prompts, plens,
+                key=jax.random.fold_in(key, i),
+                gconfig=gconfig,
+                max_new_tokens=max_gen_tokens,
+                eos_token_id=eos,
+                pad_token_id=pad,
+            )
+            out_ids = np.asarray(out["output_ids"])
+            out_lens = np.asarray(out["output_lens"])
+            tasks = [
+                task_from_record(
+                    rec, tok.decode(list(map(int, toks[: int(n)])))
+                )
+                for rec, toks, n in zip(chunk, out_ids, out_lens)
+            ]
+            scores = batch_reward(tasks)
+            for j, s in enumerate(scores):
+                per_rec_correct[i + j] += int(s > 0)
+
+    def _estimators(idxs: List[int]) -> Dict[str, float]:
+        if not idxs:
+            return {}
+        out: Dict[str, float] = {
+            "pass@1": float(np.mean(
+                [per_rec_correct[i] / k for i in idxs]
+            )),
+        }
+        if k > 1:
+            out[f"pass@{k}"] = float(np.mean(
+                [pass_at_k(k, per_rec_correct[i], k) for i in idxs]
+            ))
+            out[f"pass^{k}"] = float(np.mean(
+                [pass_hat_k(k, per_rec_correct[i], k) for i in idxs]
+            ))
+        return out
+
+    overall = _estimators(list(range(len(records))))
+    result = {
         "ckpt": ckpt_dir,
         "dataset": dataset_path,
-        "n": n_total,
-        "accuracy": n_correct / max(n_total, 1),
+        "n": len(records),
+        "k": k,
+        "temperature": None if k == 1 else temperature,
+        # Legacy field: pass@1 == greedy accuracy at k=1.
+        "accuracy": overall.get("pass@1", 0.0),
+        **overall,
         "eval_secs": round(time.time() - t0, 2),
     }
+    for kind in sorted(set(kinds)):
+        idxs = [i for i, kk in enumerate(kinds) if kk == kind]
+        for name, v in _estimators(idxs).items():
+            result[f"{kind}/{name}"] = v
+        result[f"{kind}/n"] = len(idxs)
+    return result
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -98,7 +201,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--max-gen-tokens", type=int, default=512)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--limit", type=int, default=None)
+    ap.add_argument("--k", type=int, default=1,
+                    help="samples per prompt (1 = legacy greedy accuracy)")
+    ap.add_argument("--temperature", type=float, default=0.6,
+                    help="sampling temperature for k > 1")
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mock-tokenizer", action="store_true")
+    ap.add_argument("--reward-service", nargs=2, default=None,
+                    metavar=("EXPERIMENT", "TRIAL"),
+                    help="grade through the live reward fleet of this "
+                         "experiment/trial (docs/rewards.md)")
+    ap.add_argument("--reward-service-config", default=None,
+                    help="JSON of the run's RewardServiceConfig so the "
+                         "operator's knobs (local_fallback, languages, "
+                         "timeouts) hold in this subprocess too")
     args = ap.parse_args(argv)
     result = evaluate_checkpoint(
         args.ckpt, args.dataset,
@@ -106,6 +222,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         batch_size=args.batch_size,
         mock_tokenizer=args.mock_tokenizer,
         limit=args.limit,
+        k=args.k,
+        temperature=args.temperature,
+        seed=args.seed,
+        service_experiment=args.reward_service[0] if args.reward_service
+        else "",
+        service_trial=args.reward_service[1] if args.reward_service else "",
+        service_config=(json.loads(args.reward_service_config)
+                        if args.reward_service_config else None),
     )
     with open(args.output, "w") as f:
         json.dump(result, f)

@@ -76,6 +76,7 @@ class AutomaticEvaluator:
         run_eval: Optional[Callable[[EvaluationStep], Dict]] = None,
         poll_secs: float = 5.0,
         mock_tokenizer: bool = False,
+        reward_service: Optional[tuple] = None,
     ):
         self.cfg = cfg
         self.save_dir = save_dir
@@ -84,6 +85,13 @@ class AutomaticEvaluator:
         self.writer = metric_writer
         self.poll_secs = poll_secs
         self.mock_tokenizer = mock_tokenizer
+        # (experiment, trial, nfs_name_resolve_root|"", config_json|"")
+        # when the sandbox reward fleet should grade eval generations too
+        # (docs/rewards.md) — the eval subprocess discovers the fleet
+        # through name_resolve and rebuilds the OPERATOR'S
+        # RewardServiceConfig from config_json (local_fallback and
+        # language policy must hold there too).
+        self.reward_service = reward_service
         self._run_eval = run_eval or self._subprocess_eval
         # poll_once runs _eval_one on a thread pool; tensorboard's event
         # writer is not thread-safe, so metric writes are serialized here
@@ -106,9 +114,24 @@ class AutomaticEvaluator:
             "--output", out_path,
             "--max-gen-tokens", str(self.cfg.max_gen_tokens),
         ]
+        # pass@k sampling eval (docs/rewards.md §pass@k): k>1 publishes
+        # pass@1/pass@k/pass^k per task kind to tensorboard per saved
+        # checkpoint; k=1 keeps the legacy greedy accuracy.
+        k = int(getattr(self.cfg, "eval_k", 1) or 1)
+        if k > 1:
+            cmd += ["--k", str(k),
+                    "--temperature",
+                    str(getattr(self.cfg, "temperature", 0.6))]
         if self.mock_tokenizer:
             cmd.append("--mock-tokenizer")
         env = dict(os.environ)
+        if self.reward_service is not None:
+            exp, trial, nr_root, cfg_json = self.reward_service
+            cmd += ["--reward-service", exp, trial]
+            if cfg_json:
+                cmd += ["--reward-service-config", cfg_json]
+            if nr_root:
+                env["AREAL_NAME_RESOLVE_ROOT"] = nr_root
         # Eval shares the host with training: keep it off the TPU.
         env.setdefault("JAX_PLATFORMS", "cpu")
         try:

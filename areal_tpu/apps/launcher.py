@@ -262,6 +262,20 @@ def gen_server_entry(exp_cfg, server_cfg, force_cpu: bool,
     asyncio.run(main())
 
 
+def reward_worker_entry(exp_cfg, rw_cfg) -> None:
+    """One sandbox reward worker (the sixth worker kind,
+    system/reward_worker.py). Deliberately NOT _child_init: a reward
+    worker is jax-free and must never initialize an accelerator —
+    untrusted code grades on spare CPU, not on the chips that train."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # belt: even if imported
+    from areal_tpu.experiments import common as C
+
+    C.setup_name_resolve(exp_cfg)
+    from areal_tpu.system.reward_worker import RewardWorker
+
+    RewardWorker(rw_cfg).run()
+
+
 def rollout_entry(exp_cfg, rollout_cfg, force_cpu: bool) -> None:
     _child_init(exp_cfg, force_cpu)
     import asyncio
@@ -393,7 +407,7 @@ class LocalLauncher:
             # supervise=False restores the legacy contract: any child
             # death (of any kind) escalates immediately.
             restartable_kinds=(
-                ("rollout", "gen_fleet")
+                ("rollout", "gen_fleet", "reward")
                 if getattr(self.ft, "supervise", True) else ()
             ),
         )
@@ -459,6 +473,16 @@ class LocalLauncher:
         else:
             self._spawn(trainer_entry, exp, setup["trainer"], self.force_cpu,
                         name="trainer", kind="trainer")
+        # Sandbox reward fleet (docs/rewards.md): CPU-only, supervised
+        # as a restartable stateless domain — a crashed reward worker
+        # respawns in place while clients retry on surviving replicas.
+        # Spawned BEFORE the rollout side: reward workers are jax-free
+        # and register in well under the fleet's startup time, so the
+        # first grade never races their registration into local
+        # code execution.
+        for i, rw in enumerate(setup.get("reward_workers", [])):
+            self._spawn(reward_worker_entry, exp, rw,
+                        name=f"reward{i}", kind="reward")
         if "gen_servers" in setup:
             self._spawn(
                 gen_fleet_entry, exp, setup["gen_servers"],
@@ -513,12 +537,35 @@ class LocalLauncher:
                 eval_writer = MetricWriter(
                     tensorboard_path=os.path.join(tb, "eval")
                 )
+            # With the reward fleet up, eval generations grade there too
+            # — untrusted checkpoint output must not execute in the eval
+            # subprocess either. The NFS name-resolve root rides along so
+            # the subprocess can discover the workers.
+            rs = None
+            if getattr(getattr(exp, "reward_service", None),
+                       "enabled", False):
+                import dataclasses as _dc
+                import json as _json
+
+                # The same derivation setup_name_resolve applies
+                # (experiments/common.py): explicit nfs_record_root or
+                # the per-experiment default. Non-NFS repos pass "" —
+                # the eval subprocess then uses its environment's
+                # default (memory repos cannot cross a process anyway).
+                nr_cfg = exp.cluster.name_resolve
+                nr_root = ""
+                if getattr(nr_cfg, "type", "nfs") == "nfs":
+                    nr_root = (nr_cfg.nfs_record_root
+                               or C.experiment_paths(exp)["name_resolve"])
+                rs = (exp.experiment_name, exp.trial_name, nr_root,
+                      _json.dumps(_dc.asdict(exp.reward_service)))
             evaluator = AutomaticEvaluator(
                 exp.auto_eval_config,
                 save_dir=setup["master"].save_dir,
                 dataset_path=eval_data,
                 metric_writer=eval_writer,
                 mock_tokenizer=bool(getattr(exp, "mock_tokenizer", False)),
+                reward_service=rs,
             )
             evaluator.start()
             logger.info(f"automatic evaluator watching "

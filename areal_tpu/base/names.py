@@ -49,6 +49,18 @@ def gen_server_manager(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/gserver_manager"
 
 
+def reward_worker(experiment: str, trial: str, worker_id: str) -> str:
+    """HTTP endpoint of one sandbox reward worker (the sixth worker
+    kind, system/reward_worker.py): reward clients discover the fleet
+    under the root below and fan grading requests across it
+    (rewards/client.py, docs/rewards.md)."""
+    return f"{_base(experiment, trial)}/reward_workers/{worker_id}"
+
+
+def reward_worker_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/reward_workers/"
+
+
 def model_version(experiment: str, trial: str, role: str) -> str:
     return f"{_base(experiment, trial)}/model_version/{role}"
 

@@ -99,6 +99,41 @@ def make_code_jsonl(path: str, n: int = 4, seed: int = 0) -> List[dict]:
     return records
 
 
+def make_mixed_jsonl(path: str, n_math: int = 6, n_code: int = 2,
+                     seed: int = 0) -> List[dict]:
+    """Mixed math+code RL fixture: the code-RL e2e / pass@k eval dataset
+    shape (docs/rewards.md). Math records carry boxed solutions; code
+    records carry stdin/stdout ``input_output`` cases a one-liner can
+    pass — graded by the sandbox, fully solvable in principle."""
+    rng = random.Random(seed)
+    records = []
+    for i in range(n_math):
+        a, b = rng.randint(0, 50), rng.randint(0, 50)
+        records.append({
+            "query_id": f"m{i}",
+            "prompt": f"What is {a}+{b}? ",
+            "task": "math",
+            "solutions": [f"\\boxed{{{a + b}}}"],
+        })
+    for i in range(n_code):
+        k = rng.randint(1, 5)
+        io = {
+            "inputs": [f"{x}\n" for x in range(2)],
+            "outputs": [f"{x + k}\n" for x in range(2)],
+        }
+        records.append({
+            "query_id": f"c{i}",
+            "prompt": f"Write a program that reads x and prints x+{k}. ",
+            "task": "code",
+            "solutions": [],
+            "input_output": json.dumps(io),
+        })
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return records
+
+
 def bench_trajectory_dist(seed: int = 0, n_seq: int = 32):
     """The bench.py PPO trajectory length distribution — ~250-token prompts
     + ~640-token generations — as ``(rng, plens, glens)``. The SINGLE

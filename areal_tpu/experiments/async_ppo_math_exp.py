@@ -104,10 +104,14 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 # recover checkpoints (rollout_worker.ConsumedLog).
                 recover_dir=paths["recover"],
                 telemetry=self._telemetry(),
+                # Sandbox reward fleet (docs/rewards.md): enabled, agent
+                # reward callbacks grade over HTTP on the reward workers
+                # below instead of in the rollout process.
+                reward_service=self.reward_service,
             )
             for i in range(self.n_rollout_workers)
         ]
-        return {
+        setup = {
             "dfg": self.build_dfg(self.dataset.train_bs_n_seqs,
                                   async_mode=True),
             "master": self.build_master_config(async_mode=True),
@@ -116,6 +120,9 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
             "gserver_manager": manager,
             "rollout_workers": rollout_workers,
         }
+        if self.reward_service.enabled:
+            setup["reward_workers"] = self.build_reward_workers()
+        return setup
 
 
 register_experiment("async-ppo-math", AsyncPPOMATHConfig)

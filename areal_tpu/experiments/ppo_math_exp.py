@@ -336,6 +336,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             realloc_dir=paths["realloc"],
             weight_sync=self.weight_sync,
             telemetry=self._telemetry(),
+            reward_service=self.reward_service,
         )
 
     def build_master_config(self, async_mode: bool = False):
@@ -378,14 +379,38 @@ class PPOMATHConfig(BaseExperimentConfig):
             recover=self.recover_mode == "resume",
         )
 
+    def build_reward_workers(self) -> List[Any]:
+        """Sandbox reward-worker configs (empty when the service is off);
+        shared by the sync and async experiment setups."""
+        if not self.reward_service.enabled:
+            return []
+        from areal_tpu.system.reward_worker import RewardWorkerConfig
+
+        return [
+            RewardWorkerConfig(
+                experiment=self.experiment_name, trial=self.trial_name,
+                worker_index=i,
+                port=self.reward_service.port,
+                reward=self.reward_service,
+                telemetry=self._telemetry(),
+                keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
+            )
+            for i in range(self.reward_service.n_workers)
+        ]
+
     def initial_setup(self) -> Dict[str, Any]:
         """→ {dfg, master, trainer} (sync: everything on the trainer mesh)."""
-        return {
+        setup = {
             "dfg": self.build_dfg(self.dataset.train_bs_n_seqs,
                                   async_mode=False),
             "master": self.build_master_config(async_mode=False),
             "trainer": self.build_trainer_config(async_mode=False),
         }
+        if self.reward_service.enabled:
+            # Sync mode grades on the trainer's rw_inf MFC — the fleet
+            # keeps untrusted code out of the trainer process too.
+            setup["reward_workers"] = self.build_reward_workers()
+        return setup
 
 
 register_experiment("ppo-math", PPOMATHConfig)

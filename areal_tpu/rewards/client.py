@@ -1,11 +1,29 @@
-"""Reward evaluation fanout — remote sandbox service or local fallback.
+"""Reward evaluation fanout — sandbox reward fleet or local fallback.
 
 Parity target: ``functioncall/base/call.py:81-235`` (``batch_function_call``:
 aiohttp fanout to FUNCTIONCALL_SERVICE_DOMAIN with retries and concurrency
 caps) + the dispatch in ``math_rw_interface.py:127`` (math vs code by task).
-With no service configured, grading runs locally (rewards/math_verify.py,
-rewards/code_verify.py) on a thread pool — the default for TPU pods where
-the reward sandbox is a separate deployment.
+
+Three grading modes, in precedence order (docs/rewards.md):
+
+ 1. **Reward-service fleet** (``configure_service`` with an enabled
+    RewardServiceConfig): tasks fan out over the reward workers discovered
+    through name_resolve (system/reward_worker.py) with bounded in-flight
+    concurrency, capped-exponential retry across SURVIVING replicas, a
+    per-task timeout, and partial-batch degradation to local grading when
+    the fleet is unreachable.
+ 2. **Legacy remote domain** (``FUNCTIONCALL_SERVICE_DOMAIN`` env): one
+    fixed host, same retry semantics — kept so reference-style deployments
+    keep working unchanged.
+ 3. **Local** (the default): grading runs in this process
+    (rewards/math_verify.py, rewards/code_verify.py) — bit-identical to
+    the pre-service behavior.
+
+Entrypoints: :func:`abatch_reward` (async — what agent callbacks await so
+grading never blocks the rollout event loop) and :func:`batch_reward`
+(sync — trainer-side interfaces and offline eval). Calling the sync form
+from a running event loop raises: that was the old loop-blocking
+``_run_coro_blocking`` path, replaced by the real async entrypoint.
 """
 
 from __future__ import annotations
@@ -14,13 +32,13 @@ import asyncio
 import concurrent.futures as cf
 import json
 import os
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import dataclasses
 
-from areal_tpu.base import logging
+from areal_tpu.base import logging, telemetry
 from areal_tpu.base.retry import RetryPolicy, aretry
-from areal_tpu.rewards import code_verify, math_verify
+from areal_tpu.rewards.service import grade_task, task_budget_secs
 
 logger = logging.getLogger("rewards.client")
 
@@ -31,36 +49,326 @@ SERVICE_ENV = "FUNCTIONCALL_SERVICE_DOMAIN"
 _REMOTE_RETRY = RetryPolicy(base_delay_secs=0.5, max_delay_secs=5.0)
 
 
-def _run_coro_blocking(coro):
-    """Run a coroutine to completion from ANY calling context. Plain
-    ``asyncio.run`` raises RuntimeError when the caller's thread already
-    hosts a running event loop (the async rollout path calls reward grading
-    from agent callbacks) — in that case run it on a dedicated thread with
-    its own loop instead."""
-    try:
-        asyncio.get_running_loop()
-    except RuntimeError:
-        return asyncio.run(coro)
-    logger.warning(
-        "batch_reward called on a running event loop; grading on a "
-        "dedicated thread BLOCKS this loop until the batch completes — "
-        "prefer asyncio.to_thread(batch_reward, ...) from async code"
-    )
-    with cf.ThreadPoolExecutor(max_workers=1) as pool:
-        return pool.submit(asyncio.run, coro).result()
-
-
-def _grade_local(task: Dict[str, Any]) -> float:
-    kind = task.get("task", "math")
-    if kind in ("math", "stem"):
-        return math_verify.verify_math(task["generated"], task.get("solutions", []))
+def task_from_record(record: Dict[str, Any], generated: str) -> Dict[str, Any]:
+    """The ONE dataset-record → grading-task builder, shared by the
+    rollout envs, the trainer's rw interface, and the eval harness — so
+    per-task fields (``input_output``, ``language``) cannot silently be
+    forwarded by some callers and dropped by others."""
+    kind = record.get("task", "math")
+    task: Dict[str, Any] = {"task": kind, "generated": generated}
     if kind == "code":
-        return code_verify.verify_code(
-            task["generated"], task.get("input_output", "{}"),
-            timeout=float(task.get("timeout", 8.0)),
+        task["input_output"] = record.get("input_output", "{}")
+        if "language" in record:
+            task["language"] = record["language"]
+    else:
+        task["solutions"] = record.get("solutions", [])
+    return task
+
+
+def _grade_local(task: Dict[str, Any],
+                 languages: Optional[List[str]] = None) -> float:
+    """Local grading — the SAME dispatch the fleet runs
+    (rewards/service.py grade_task), so fallback outputs are
+    bit-identical to fleet outputs; only the tripwire counter differs.
+    ``languages`` carries the service's language policy into the
+    FALLBACK path (an excluded language must not execute locally just
+    because the fleet was unreachable); None = no policy (legacy local
+    mode)."""
+    if task.get("task", "math") == "code":
+        # In-calling-process code execution is exactly what the reward
+        # service exists to remove — count it so a healthy service run
+        # can assert zero (docs/rewards.md).
+        telemetry.inc("reward_client/local_graded{task=code}")
+    return float(grade_task(task, languages=languages)["score"])
+
+
+# --------------------------------------------------------------------------
+# reward-service fleet client
+# --------------------------------------------------------------------------
+
+
+class RewardServiceClient:
+    """Fanout client for the sandbox reward fleet (docs/rewards.md).
+
+    Worker discovery is lazy and refreshed on failure: a task whose POST
+    fails marks that URL tried and retries on a DIFFERENT live replica
+    (re-resolving the fleet between attempts, so a respawned worker's
+    fresh URL is picked up mid-batch). The retry budget exhausted —
+    or no replica reachable at all — degrades that TASK to local grading
+    when ``local_fallback`` allows, else scores it 0.0; either way one
+    dead worker never fails a whole batch.
+    """
+
+    def __init__(self, cfg, experiment: str = "", trial: str = "",
+                 urls: Optional[List[str]] = None,
+                 resolver=None):  # cfg: RewardServiceConfig
+        self.cfg = cfg
+        self.experiment = experiment
+        self.trial = trial
+        self._urls: List[str] = list(urls or [])
+        self._rr = 0  # round-robin cursor
+        if resolver is not None:
+            self._resolver = resolver
+        elif experiment:
+            from areal_tpu.system.reward_worker import resolve_fleet
+
+            self._resolver = lambda: resolve_fleet(experiment, trial)
+        else:
+            self._resolver = lambda: []
+        self.policy = RetryPolicy(
+            max_attempts=max(int(cfg.max_retries) + 1, 1),
+            base_delay_secs=cfg.retry_base_delay_secs,
+            max_delay_secs=cfg.retry_max_delay_secs,
         )
-    logger.warning(f"unknown reward task kind {kind}; 0 reward")
-    return 0.0
+        # Externally-owned ClientSession (use_session): the rollout
+        # worker attaches its long-lived session so fleet POSTs reuse
+        # keepalive connections instead of building a pool per batch.
+        self._ext_session = None
+        # Shared in-flight resolve (arefresh): when a replica dies with
+        # 64 grades in flight, ONE name-resolve walk serves them all
+        # instead of a 64-way NFS stampede.
+        self._refresh_task: Optional[asyncio.Task] = None
+        # Cold start: first-ever resolve gets bounded patience (the
+        # fleet may still be registering at launch).
+        self._fleet_seen = bool(urls)
+
+    COLD_START_WAIT_SECS = 10.0
+
+    async def _await_fleet(self) -> None:
+        """Bounded wait for the FIRST registration. Before any worker
+        has ever been seen, degrading to local code execution because
+        the fleet is 0.5s late registering would defeat the sandbox —
+        poll for up to COLD_START_WAIT_SECS instead. Once a fleet has
+        been seen, dead-fleet handling belongs to the normal retry
+        budget (a vanished fleet should degrade promptly, not stall
+        every batch ten seconds)."""
+        if self._fleet_seen:
+            return
+        deadline = (asyncio.get_running_loop().time()
+                    + self.COLD_START_WAIT_SECS)
+        while not self._urls:
+            await self.arefresh()
+            if self._urls:
+                break
+            telemetry.inc("reward_client/fleet_empty")
+            if asyncio.get_running_loop().time() >= deadline:
+                break
+            await asyncio.sleep(0.25)
+        # The window is consumed either way — a fleet that never comes
+        # up must not re-stall EVERY later batch ten seconds; from here
+        # on, dead-fleet handling belongs to the normal retry budget.
+        self._fleet_seen = True
+
+    def use_session(self, session) -> None:
+        """Attach an externally-owned aiohttp session (closed by its
+        owner, never by this client); ``abatch`` reuses it while open."""
+        self._ext_session = session
+
+    def refresh(self) -> List[str]:
+        """Re-resolve the fleet (BLOCKING name_resolve I/O — async
+        callers go through :meth:`arefresh`)."""
+        fresh = self._resolver()
+        if fresh:
+            self._urls = list(fresh)
+        return self._urls
+
+    async def arefresh(self) -> List[str]:
+        """Re-resolve off the loop (name_resolve walks an NFS tree —
+        the loop-blocking this client's async entrypoint exists to
+        avoid), sharing ONE walk among concurrent callers."""
+        loop = asyncio.get_running_loop()
+        t = self._refresh_task
+        if t is None or t.done() or t.get_loop() is not loop:
+            t = self._refresh_task = asyncio.ensure_future(
+                asyncio.to_thread(self.refresh)
+            )
+        # Shield: one cancelled awaiter must not kill the walk the
+        # other 63 in-flight grades are waiting on.
+        return await asyncio.shield(t)
+
+    def _pick(self, exclude=()) -> Optional[str]:
+        """Next replica round-robin, skipping already-tried URLs; with
+        every replica tried, fall back to any (a blip may have passed)."""
+        pool = [u for u in self._urls if u not in exclude] or self._urls
+        if not pool:
+            return None
+        self._rr += 1
+        return pool[self._rr % len(pool)]
+
+    @staticmethod
+    def _endpoint(task: Dict[str, Any]) -> str:
+        return "math_verify" \
+            if task.get("task", "math") in ("math", "stem") else "code_verify"
+
+    async def grade_one(self, session, task: Dict[str, Any],
+                        sem: asyncio.Semaphore) -> float:
+        import aiohttp
+
+        async with sem:
+            await self._await_fleet()  # cold start only; no-op after
+            # Budget computed ONCE per task (task_budget_secs parses
+            # input_output, which can be multi-MB for competitive-
+            # programming records — not per retry attempt on the loop).
+            http_total = task_budget_secs(task, max(
+                float(self.cfg.request_timeout_secs),
+                float(self.cfg.grade_timeout_secs),
+            )) + 15.0
+            tried: set = set()
+            for attempt in range(1, self.policy.max_attempts + 1):
+                if not self._urls:
+                    await self.arefresh()
+                url = self._pick(exclude=tried)
+                if url is None:
+                    # Fleet not (yet) resolvable — the cold-start race:
+                    # workers may still be registering. Burn an attempt
+                    # WITH backoff (same budget as a connect failure)
+                    # instead of degrading to local code execution on
+                    # the first miss.
+                    telemetry.inc("reward_client/fleet_empty")
+                    if attempt < self.policy.max_attempts:
+                        await asyncio.sleep(self.policy.delay(attempt))
+                    continue
+                try:
+                    async with session.post(
+                        f"{url}/{self._endpoint(task)}", json=task,
+                        # Same per-task floor as the server's grade
+                        # budget (+queue/network headroom): the client
+                        # must never abandon a grade the server is
+                        # still legally running — that retry would run
+                        # a duplicate grade per replica and end in
+                        # local execution of the very code being boxed.
+                        # The base takes grade_timeout_secs too: a
+                        # raised server budget (slow sympy math) must
+                        # raise the client's patience with it.
+                        timeout=aiohttp.ClientTimeout(total=http_total),
+                    ) as r:
+                        if 400 <= r.status < 500 and r.status not in (
+                            408, 429,
+                        ):
+                            # Deterministic rejection (malformed task):
+                            # no replica will accept it — fail fast to
+                            # the degradation path instead of burning
+                            # the whole retry budget fleet-wide.
+                            telemetry.inc("reward_client/bad_request")
+                            logger.warning(
+                                f"reward worker {url} rejected task "
+                                f"(http {r.status}); not retrying"
+                            )
+                            break
+                        if r.status != 200:
+                            raise RuntimeError(f"http {r.status}")
+                        out = await r.json()
+                    telemetry.inc("reward_client/remote")
+                    self._fleet_seen = True
+                    return float(out.get("score", 0.0))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — replica failed
+                    # Mid-batch worker death: mark THIS url tried so the
+                    # next attempt lands on a surviving replica, and
+                    # re-resolve (a respawn registers a fresh URL).
+                    tried.add(url)
+                    telemetry.inc("reward_client/retries")
+                    logger.warning(
+                        f"reward worker {url} failed ({e}); "
+                        f"attempt {attempt}/{self.policy.max_attempts}"
+                    )
+                    await self.arefresh()
+                    if attempt < self.policy.max_attempts:
+                        await asyncio.sleep(self.policy.delay(attempt))
+            # Partial-batch degradation: only the tasks whose budget ran
+            # out leave the fleet path.
+            telemetry.inc("reward_client/local_fallback")
+            if not self.cfg.local_fallback:
+                logger.warning(
+                    "reward fleet unreachable and local_fallback=false; "
+                    "scoring 0.0"
+                )
+                return 0.0
+            return await asyncio.to_thread(
+                _grade_local, task, list(self.cfg.languages)
+            )
+
+    async def abatch(self, tasks: List[Dict[str, Any]]) -> List[float]:
+        import aiohttp
+
+        sem = asyncio.Semaphore(max(int(self.cfg.max_concurrency), 1))
+        # Hot path (rollout worker): reuse the owner-attached session so
+        # keepalive connections persist across batches. Without one
+        # (trainer's per-batch asyncio.run, tools), a per-call session
+        # is correct — a cached session cannot outlive its loop.
+        session = self._ext_session
+        if session is not None and not session.closed:
+            return list(await asyncio.gather(
+                *[self.grade_one(session, t, sem) for t in tasks]
+            ))
+        async with aiohttp.ClientSession() as session:
+            return list(await asyncio.gather(
+                *[self.grade_one(session, t, sem) for t in tasks]
+            ))
+
+
+# Module-level service mode: configured once per worker process
+# (rollout worker / trainer startup), consumed by every batch_reward /
+# abatch_reward call site without threading a client through.
+_SERVICE_CLIENT: Optional[RewardServiceClient] = None
+
+
+def configure_service(cfg, experiment: str = "", trial: str = "",
+                      urls: Optional[List[str]] = None,
+                      resolver=None) -> Optional[RewardServiceClient]:
+    """Install (or clear) the process-wide reward-service client. A None
+    or disabled config clears it — grading returns to the local path."""
+    global _SERVICE_CLIENT
+    if cfg is None or not getattr(cfg, "enabled", False):
+        _SERVICE_CLIENT = None
+        return None
+    _SERVICE_CLIENT = RewardServiceClient(
+        cfg, experiment, trial, urls=urls, resolver=resolver
+    )
+    logger.info(
+        f"reward grading in service mode ({cfg.n_workers} workers, "
+        f"concurrency {cfg.max_concurrency}, retries {cfg.max_retries})"
+    )
+    return _SERVICE_CLIENT
+
+
+def service_client() -> Optional[RewardServiceClient]:
+    return _SERVICE_CLIENT
+
+
+# --------------------------------------------------------------------------
+# entrypoints
+# --------------------------------------------------------------------------
+
+
+async def abatch_reward(
+    tasks: List[Dict[str, Any]],
+    max_workers: int = 8,
+    max_retries: int = 2,
+) -> List[float]:
+    """Async grading of a batch of {task, generated, solutions|input_output}
+    dicts — the entrypoint agent callbacks await, so grading never blocks
+    the rollout event loop (no dedicated-thread bridge, no loop warning).
+
+    Service mode (configure_service) fans out over the reward fleet; the
+    legacy FUNCTIONCALL_SERVICE_DOMAIN env falls back to the fixed-host
+    remote path; otherwise tasks grade locally on a bounded to_thread
+    fanout (the event loop stays responsive either way)."""
+    if not tasks:
+        return []
+    if _SERVICE_CLIENT is not None:
+        return await _SERVICE_CLIENT.abatch(tasks)
+    domain = os.getenv(SERVICE_ENV, "")
+    if domain:
+        return await _abatch_domain(tasks, domain, max_retries)
+    sem = asyncio.Semaphore(max(int(max_workers), 1))
+
+    async def one(t):
+        async with sem:
+            return await asyncio.to_thread(_grade_local, t)
+
+    return list(await asyncio.gather(*[one(t) for t in tasks]))
 
 
 def batch_reward(
@@ -68,27 +376,39 @@ def batch_reward(
     max_workers: int = 8,
     max_retries: int = 2,
 ) -> List[float]:
-    """Grade a batch of {task, generated, solutions|input_output} dicts.
+    """Synchronous grading (trainer-side interfaces, offline eval).
 
-    Uses the remote sandbox when FUNCTIONCALL_SERVICE_DOMAIN is set
-    (one POST per chunk, retried), else the local thread-pool path."""
+    Calling this from a running event loop raises — await
+    :func:`abatch_reward` there instead (the old behavior silently
+    BLOCKED the loop on a dedicated grading thread)."""
     if not tasks:
         return []
-    domain = os.getenv(SERVICE_ENV, "")
-    if domain:
-        return _batch_remote(tasks, domain, max_retries)
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        pass
+    else:
+        raise RuntimeError(
+            "batch_reward called on a running event loop; "
+            "await abatch_reward(tasks) instead — the sync form would "
+            "block every in-flight rollout until the batch completes"
+        )
+    if _SERVICE_CLIENT is not None or os.getenv(SERVICE_ENV, ""):
+        return asyncio.run(abatch_reward(tasks, max_workers, max_retries))
+    # Local path: bit-identical to the pre-service behavior.
     if len(tasks) == 1:
         return [_grade_local(tasks[0])]
     with cf.ThreadPoolExecutor(max_workers=max_workers) as pool:
         return list(pool.map(_grade_local, tasks))
 
 
-def _batch_remote(tasks, domain: str, max_retries: int) -> List[float]:
+async def _abatch_domain(tasks, domain: str, max_retries: int) -> List[float]:
+    """Legacy fixed-host remote path (FUNCTIONCALL_SERVICE_DOMAIN)."""
     try:
         import aiohttp
     except ImportError:
         logger.warning(f"{SERVICE_ENV} set but aiohttp unavailable; local grading")
-        return [_grade_local(t) for t in tasks]
+        return [await asyncio.to_thread(_grade_local, t) for t in tasks]
 
     policy = dataclasses.replace(_REMOTE_RETRY, max_attempts=max_retries + 1)
 
@@ -105,11 +425,10 @@ def _batch_remote(tasks, domain: str, max_retries: int) -> List[float]:
                 return await aretry(post_once, policy)
             except Exception as e:  # noqa: BLE001 — retries exhausted
                 logger.warning(f"remote reward failed ({e}); local fallback")
-                return _grade_local(task)
+                return await asyncio.to_thread(_grade_local, task)
 
-    async def run():
-        sem = asyncio.Semaphore(64)
-        async with aiohttp.ClientSession() as session:
-            return await asyncio.gather(*[call_one(session, t, sem) for t in tasks])
-
-    return list(_run_coro_blocking(run()))
+    sem = asyncio.Semaphore(64)
+    async with aiohttp.ClientSession() as session:
+        return list(await asyncio.gather(
+            *[call_one(session, t, sem) for t in tasks]
+        ))

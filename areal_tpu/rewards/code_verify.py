@@ -20,7 +20,8 @@ import signal
 import subprocess
 import sys
 import tempfile
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.base import logging
 
@@ -34,6 +35,13 @@ MAX_OUTPUT_BYTES = 4 * 1024 * 1024  # cap read-back of graded program output
 # rlimits + os/builtins disarm before running untrusted model code).
 MEM_LIMIT_BYTES = 1024 * 1024 * 1024  # RLIMIT_AS
 FSIZE_LIMIT_BYTES = 64 * 1024 * 1024  # RLIMIT_FSIZE
+
+# Default cap on test cases sampled per grade. THE shared constant: the
+# reward service's wall-budget floor (rewards/service.py task_budget_secs)
+# and the pass-rate agent's fanout cap (agents/code_single_step.py)
+# derive from it — a larger per-call max_cases must come with a larger
+# grade/request budget.
+MAX_CASES_DEFAULT = 16
 
 # Injected ABOVE the untrusted code: disarm os-level footguns and
 # escape hatches inside the child (belt; the rlimits below are braces).
@@ -133,6 +141,18 @@ def _run_one(
     err_f = tempfile.NamedTemporaryFile("w+", delete=False)
     scratch = tempfile.mkdtemp(prefix="areal_sbx_")
     proc = None
+
+    def _reap_group(p) -> None:
+        """SIGKILL the graded program's whole session, then reap the
+        leader. Callers guarantee the leader is alive or an UNREAPED
+        zombie — the zombie pins the pid/pgid, so this killpg can never
+        hit an unrelated (recycled) process group."""
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        p.wait()
+
     try:
         proc = subprocess.Popen(
             [sys.executable, path],
@@ -146,16 +166,42 @@ def _run_one(
             start_new_session=True,
             preexec_fn=_child_limits(int(timeout) + 1),
         )
-        try:
-            proc.communicate(stdin, timeout=timeout)
-        except subprocess.TimeoutExpired:
-            # Kill the whole session: with os.setsid in the child, forked
-            # grandchildren would otherwise outlive the timeout.
+        # stdin fed from a side thread (communicate()'s deadlock
+        # avoidance) because the wait below must NOT reap the child:
+        # communicate/wait/poll all reap on exit, and killing the
+        # process group through a REAPED leader's pid would race pid
+        # recycling. waitid(WNOWAIT) observes exit while leaving the
+        # zombie in place, so the group sweep in _reap_group — which
+        # must run on EVERY exit path: fn_name solutions that spawned
+        # children, or a leader that exited leaving grandchildren,
+        # cannot outlive their grading slot — always targets OUR group.
+        import threading
+
+        def _feed():
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            proc.wait()
+                if stdin:
+                    proc.stdin.write(stdin)
+                proc.stdin.close()
+            except (BrokenPipeError, OSError, ValueError):
+                pass  # child exited without reading; its verdict decides
+
+        threading.Thread(target=_feed, daemon=True).start()
+
+        def _exited() -> bool:
+            return os.waitid(
+                os.P_PID, proc.pid,
+                os.WEXITED | os.WNOHANG | os.WNOWAIT,
+            ) is not None
+
+        deadline = time.monotonic() + timeout
+        while not (exited := _exited()) and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # One FINAL check past the deadline: a program that exited during
+        # the last sleep slice (or a GIL-delayed wakeup) finished within
+        # its budget and must not be misgraded as a timeout.
+        timed_out = not exited and not _exited()
+        _reap_group(proc)  # group sweep + reap (sets returncode)
+        if timed_out:
             return False, "timeout"
         err_f.seek(0)
         if proc.returncode != 0:
@@ -165,11 +211,29 @@ def _run_one(
     finally:
         import shutil
 
+        # Exception path (spawn/waitid raised): the leader, if any, was
+        # never reaped — the sweep is still pid-safe.
+        if proc is not None and proc.returncode is None:
+            _reap_group(proc)
         for fh in (out_f, err_f):
             fh.close()
             os.unlink(fh.name)
         os.unlink(path)
         shutil.rmtree(scratch, ignore_errors=True)
+
+
+def sample_cases(inputs: List, outputs: List,
+                 max_cases: int = MAX_CASES_DEFAULT) -> List[Tuple]:
+    """Deterministic (input, output) sample honoring ``max_cases`` for
+    EVERY length via a ceil-division stride (floor division let sizes
+    just above the cap through at full count). THE sampling policy —
+    the strict grader here and the pass-rate agent
+    (agents/code_single_step.py) must pick the same cases."""
+    cases = list(zip(inputs, outputs))
+    if not cases:
+        return []
+    step = -(-len(cases) // max(int(max_cases), 1))
+    return cases[::step]
 
 
 def _outputs_match(got: str, want: str) -> bool:
@@ -192,9 +256,31 @@ def verify_code(
     generated: str,
     input_output: str | Dict,
     timeout: float = 8.0,
-    max_cases: int = 16,
+    max_cases: int = MAX_CASES_DEFAULT,
+    language: str = "python",
 ) -> float:
-    """1.0 iff the extracted program passes ALL (sampled) test cases."""
+    """1.0 iff the extracted program passes ALL (sampled) test cases.
+
+    ``language`` dispatches through :data:`GRADERS`; an unregistered
+    language grades 0.0 (logged) instead of raising, so a mixed-language
+    dataset degrades per task rather than killing the reward path."""
+    grader = GRADERS.get(language)
+    if grader is None:
+        logger.warning(
+            f"no grader registered for language {language!r} "
+            f"(available: {', '.join(sorted(GRADERS))}); 0 reward"
+        )
+        return 0.0
+    return grader(generated, input_output, timeout=timeout,
+                  max_cases=max_cases)
+
+
+def _verify_code_python(
+    generated: str,
+    input_output: str | Dict,
+    timeout: float = 8.0,
+    max_cases: int = MAX_CASES_DEFAULT,
+) -> float:
     code = extract_code(generated)
     if code is None:
         return 0.0
@@ -204,8 +290,7 @@ def verify_code(
     fn_name = io.get("fn_name")
     if not inputs:
         return 0.0
-    step = max(1, len(inputs) // max_cases)
-    for inp, want in list(zip(inputs, outputs))[::step]:
+    for inp, want in sample_cases(inputs, outputs, max_cases):
         if fn_name:
             stdin = inp if isinstance(inp, str) else json.dumps(inp)
             ok, got = _run_one(code, stdin, timeout, fn_name=fn_name)
@@ -225,6 +310,21 @@ def verify_code(
             if not ok or not _outputs_match(got, want):
                 return 0.0
     return 1.0
+
+
+# Per-task language dispatch (docs/rewards.md): the reward service routes
+# each code task's ``language`` field (default "python") through this
+# registry, so C++/bash graders slot in as new entries — subprocess +
+# rlimit guard included — without touching the service or client.
+GRADERS: Dict[str, Any] = {"python": _verify_code_python}
+
+
+def register_grader(language: str, fn) -> None:
+    """Register a code grader: ``fn(generated, input_output, *, timeout,
+    max_cases) -> float``. New-language graders MUST sandbox like the
+    python one (subprocess + ``_child_limits`` rlimits +
+    ``start_new_session`` with a finally-killpg sweep)."""
+    GRADERS[language] = fn
 
 
 def batch_verify_code(

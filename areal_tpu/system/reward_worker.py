@@ -1,0 +1,190 @@
+"""Reward worker — the sixth worker kind: a sandbox fleet member.
+
+Parity target: the reference's standalone functioncall reward service
+(SURVEY §2.13), recast as a first-class worker in this system's lifecycle
+vocabulary: it registers through ``name_resolve``
+(``names.reward_worker``), serves ``/health`` + Prometheus ``/metrics``,
+pushes per-task-kind latency/verdict/timeout telemetry to the master's
+aggregator, heartbeats a liveness lease, answers WorkerControl
+(pause/resume/exit/status), and rides launcher supervision as a
+restartable stateless domain — a crashed reward worker respawns in place
+while clients retry on the surviving replicas (rewards/client.py).
+
+The grading core (HTTP endpoints, sandbox subprocess pools, language
+dispatch) lives in rewards/service.py; this module is the process glue.
+CPU-only by design: a reward worker must never initialize an accelerator
+— untrusted code runs on whatever host has spare cores, not on the chips
+that train (docs/rewards.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Optional
+
+from areal_tpu.api.train_config import RewardServiceConfig, TelemetryConfig
+from areal_tpu.base import logging, name_resolve, names, network, telemetry
+from areal_tpu.rewards.service import RewardService
+
+logger = logging.getLogger("system.reward_worker")
+
+
+@dataclasses.dataclass
+class RewardWorkerConfig:
+    experiment: str = "exp"
+    trial: str = "trial"
+    worker_index: int = 0
+    # Fixed port (0 = random); discovery goes through name_resolve either
+    # way, so fixed ports only matter for firewalled deployments.
+    port: int = 0
+    reward: RewardServiceConfig = dataclasses.field(
+        default_factory=RewardServiceConfig
+    )
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
+    # Liveness lease on the reward_workers/ registration: a SIGKILLed
+    # worker's ghost URL expires from discovery instead of being fanned
+    # out to forever. 0 falls back to the supervisor-set env TTL.
+    keepalive_ttl_secs: float = 0.0
+
+
+class RewardWorker:
+    """Owns one RewardService + its fleet registration and control."""
+
+    def __init__(self, cfg: RewardWorkerConfig, grade_fn=None):
+        self.cfg = cfg
+        self.worker_id = f"rw{cfg.worker_index}"
+        # Own instance (not the process global): tests host several
+        # workers in one process, and each must be a distinct
+        # (worker_kind, worker_index) at the aggregator.
+        self.telemetry = (
+            telemetry.Telemetry(
+                cfg.experiment, cfg.trial, "reward", cfg.worker_index,
+                cfg=cfg.telemetry,
+            ) if cfg.telemetry.enabled else telemetry.NULL
+        )
+        self.service = RewardService(
+            cfg.reward, telemetry_sink=self.telemetry, grade_fn=grade_fn
+        )
+        self.url = ""
+        self._t_start = time.monotonic()
+        self._runner_obj = None
+        self._hb = None
+
+    async def start(self) -> str:
+        """Serve + register under names.reward_worker; returns the URL."""
+        from aiohttp import web
+
+        from areal_tpu.system.worker_base import (
+            HeartbeatThread,
+            default_heartbeat_interval,
+            env_keepalive_ttl,
+        )
+
+        app = self.service.build_app(
+            extra_metrics=lambda: {
+                "reward_worker_uptime_secs":
+                    time.monotonic() - self._t_start,
+            },
+            labels={"worker_id": self.worker_id},
+        )
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = (self.cfg.port + self.cfg.worker_index) if self.cfg.port \
+            else network.find_free_port()
+        site = web.TCPSite(runner, network.bind_addr(), port)
+        await site.start()
+        self._runner_obj = runner
+        self.url = f"http://{network.gethostip()}:{port}"
+        ttl = self.cfg.keepalive_ttl_secs or env_keepalive_ttl() or 0.0
+        key = names.reward_worker(self.cfg.experiment, self.cfg.trial,
+                                  self.worker_id)
+        name_resolve.add(key, self.url, replace=True,
+                         keepalive_ttl=ttl or None)
+        if ttl:
+            # Dedicated thread, same contract as the generation server: a
+            # worker wedged in a long grade must still look alive; only a
+            # SIGKILL (which takes the thread too) lapses the lease. The
+            # heartbeat name matches the launcher's WorkerSpec name
+            # (f"reward{i}") so the supervisor's respawn purge finds the
+            # dead incarnation's record.
+            self._hb = HeartbeatThread(
+                self.cfg.experiment, self.cfg.trial,
+                f"reward{self.cfg.worker_index}",
+                interval=default_heartbeat_interval(ttl),
+            )
+            self._hb.lease(key, self.url, ttl)
+        logger.info(f"reward worker {self.worker_id} at {self.url} "
+                    f"(pool={self.cfg.reward.pool_size}, "
+                    f"languages={list(self.cfg.reward.languages)})"
+                    + (f" (keepalive {ttl:.0f}s)" if ttl else ""))
+        return self.url
+
+    async def stop(self) -> None:
+        if self._hb is not None:
+            self._hb.close()
+        # Withdraw discovery NOW so client fanout forgets this URL
+        # instead of burning a retry against a closing socket.
+        try:
+            name_resolve.delete(names.reward_worker(
+                self.cfg.experiment, self.cfg.trial, self.worker_id
+            ))
+        except Exception:  # noqa: BLE001 — already gone / repo reset
+            pass
+        if self._runner_obj is not None:
+            await self._runner_obj.cleanup()
+        self.service.close()
+        self.telemetry.close()
+
+    async def run_async(self) -> None:
+        """Serve until WorkerControl commands exit (the launcher-spawned
+        entry; tests drive start/stop directly)."""
+        from areal_tpu.system.worker_base import WorkerControl
+
+        await self.start()
+        ctrl = WorkerControl(
+            self.cfg.experiment, self.cfg.trial,
+            f"reward{self.cfg.worker_index}",
+        )
+        try:
+            while True:
+                # Control served between sleeps; pause blocks inside step
+                # (grading already in flight still completes — the HTTP
+                # server keeps serving; pause gates nothing here because
+                # a reward worker holds no training state to freeze).
+                await asyncio.to_thread(
+                    ctrl.step,
+                    lambda: {
+                        "url": self.url,
+                        "graded": self.service._graded,
+                        "inflight": self.service._inflight,
+                        "timeouts": self.service._timeouts,
+                    },
+                    200,
+                )
+                if ctrl.should_exit:
+                    break
+        finally:
+            ctrl.close()
+            await self.stop()
+        logger.info(
+            f"reward worker {self.worker_id} done: "
+            f"{self.service._graded} graded, "
+            f"{self.service._timeouts} timeouts"
+        )
+
+    def run(self) -> None:
+        asyncio.run(self.run_async())
+
+
+def resolve_fleet(experiment: str, trial: str) -> list:
+    """Live reward-worker URLs from name_resolve (sorted for stable
+    round-robin). The ONE discovery helper clients and tools share."""
+    root = names.reward_worker_root(experiment, trial)
+    try:
+        return sorted(name_resolve.get_subtree(root))
+    except Exception:  # noqa: BLE001 — repo unreachable counts as empty
+        return []

@@ -21,8 +21,9 @@ import numpy as np
 import areal_tpu.agents  # noqa: F401 — registers built-in agents/envs
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import GenerationHyperparameters, make_agent
-from areal_tpu.api.train_config import TelemetryConfig
+from areal_tpu.api.train_config import RewardServiceConfig, TelemetryConfig
 from areal_tpu.base import logging, name_resolve, names, telemetry
+from areal_tpu.rewards import client as reward_client
 from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl, load_shuffle_split
 from areal_tpu.base.retry import (
     DEFAULT_GENERATION_RETRY,
@@ -49,6 +50,11 @@ class RolloutWorkerConfig:
     trainer_handler: str = "trainer"  # puller name to push to
     agent: str = "math_single_step"
     agent_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Reward environment (api/model env registry). The default grades
+    # math AND code by task kind; code-RL workloads can pick
+    # "code_single_step" (format gate + optional pass-rate credit).
+    env: str = "math_code_single_step"
+    env_args: Dict[str, Any] = dataclasses.field(default_factory=dict)
     gconfig: GenerationHyperparameters = dataclasses.field(
         default_factory=GenerationHyperparameters
     )
@@ -75,6 +81,12 @@ class RolloutWorkerConfig:
     # spans, chunk-latency histograms, staleness lag. Off by default.
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
+    )
+    # Sandbox reward fleet (docs/rewards.md): enabled, agent reward
+    # callbacks fan grading out to the reward workers instead of
+    # executing verification in THIS process. Off = legacy local grading.
+    reward_service: RewardServiceConfig = dataclasses.field(
+        default_factory=RewardServiceConfig
     )
 
 
@@ -152,9 +164,15 @@ class RolloutWorker:
         self.agent = make_agent(
             cfg.agent, tokenizer=cfg.tokenizer, **cfg.agent_args
         )
-        from areal_tpu.agents.math_single_step import MathCodeSingleStepEnv
+        from areal_tpu.api.model import make_env
 
-        self.env = MathCodeSingleStepEnv(self.id2info)
+        self.env = make_env(cfg.env, self.id2info, **cfg.env_args)
+        # Reward grading mode for THIS worker process (rewards/client.py):
+        # with the service enabled, agent callbacks fan grading out to the
+        # sandbox fleet — zero in-rollout-process code execution.
+        reward_client.configure_service(
+            cfg.reward_service, cfg.experiment, cfg.trial
+        )
         self.consumed = ConsumedLog(cfg.recover_dir, cfg.worker_index)
         self._mgr_url0 = ""  # pre-client bootstrap; see _mgr_url property
         self._done = 0
@@ -399,6 +417,12 @@ class RolloutWorker:
         )
         pusher = ZmqPusher(cfg.experiment, cfg.trial, cfg.trainer_handler)
         async with aiohttp.ClientSession() as session:
+            # Reward fanout rides this worker's long-lived session
+            # (keepalive reuse across grade batches); the async-with
+            # owns its lifetime — the client never closes it.
+            svc = reward_client.service_client()
+            if svc is not None:
+                svc.use_session(session)
             client = PartialRolloutClient(
                 self._mgr_url, session, chunk_tokens=cfg.chunk_tokens,
                 retry=cfg.retry, fault_injector=self.faults,

@@ -38,7 +38,11 @@ from areal_tpu.api.model import (
     make_dataset,
     make_interface,
 )
-from areal_tpu.api.train_config import TelemetryConfig, WeightSyncConfig
+from areal_tpu.api.train_config import (
+    RewardServiceConfig,
+    TelemetryConfig,
+    WeightSyncConfig,
+)
 from areal_tpu.base import logging, name_resolve, names, telemetry
 from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
 
@@ -92,6 +96,13 @@ class TrainerWorkerConfig:
     # latency gauges, profiler trigger. Off by default — zero overhead.
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
+    )
+    # Sandbox reward fleet (docs/rewards.md): enabled, trainer-side
+    # reward interfaces (sync-mode rw_math_code / fused) grade over HTTP
+    # instead of executing verification in the trainer process. Off =
+    # legacy local grading, bit-identical.
+    reward_service: RewardServiceConfig = dataclasses.field(
+        default_factory=RewardServiceConfig
     )
     # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
     # one per host — join one jax.distributed program; rank 0 owns every
@@ -188,6 +199,15 @@ class TrainerWorker:
             self.interfaces[mfc_name] = make_interface(
                 mc.interface, **mc.interface_args
             )
+        # Reward grading mode for THIS process (rewards/client.py): the
+        # sync-mode rw interface's batch_reward calls fan out to the
+        # sandbox fleet when the service is enabled; disabled keeps the
+        # legacy in-process path bit-identical.
+        from areal_tpu.rewards import client as reward_client
+
+        reward_client.configure_service(
+            cfg.reward_service, cfg.experiment, cfg.trial
+        )
         # Rank 0 owns the data plane and the master's request socket; other
         # ranks receive everything via broadcast.
         if cfg.dataset is not None and self._rank0:

@@ -6,6 +6,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from areal_tpu.api.cli_args import AutomaticEvaluatorConfig
 from areal_tpu.apps.evaluator import (
@@ -75,6 +76,57 @@ def test_evaluator_runs_injected_eval_and_logs(tmp_path):
     ev._run_eval = boom
     assert ev.poll_once() == 0
     assert ev.steps[-1].status == "failed"
+
+
+def test_pass_at_k_estimators():
+    """Unbiased pass@k (Codex eq. 1) + pass^k sanity: closed-form values
+    and the degenerate edges."""
+    from areal_tpu.apps.eval_ckpt import pass_at_k, pass_hat_k
+
+    # all correct / none correct
+    assert pass_at_k(4, 4, 4) == 1.0 and pass_at_k(4, 0, 4) == 0.0
+    assert pass_hat_k(4, 4, 4) == 1.0 and pass_hat_k(4, 0, 1) == 0.0
+    # n=4, c=2, k=1: plain accuracy 0.5
+    assert pass_at_k(4, 2, 1) == 0.5
+    # n=4, c=2, k=2: 1 - C(2,2)/C(4,2) = 1 - 1/6
+    assert pass_at_k(4, 2, 2) == 1.0 - 1.0 / 6.0
+    # pass^2 with c=2 of 4: C(2,2)/C(4,2) = 1/6
+    assert pass_hat_k(4, 2, 2) == 1.0 / 6.0
+    # pass@k is monotone in k; pass^k anti-monotone
+    assert pass_at_k(8, 3, 4) >= pass_at_k(8, 3, 2) >= pass_at_k(8, 3, 1)
+    assert pass_hat_k(8, 3, 1) >= pass_hat_k(8, 3, 2) >= pass_hat_k(8, 3, 3)
+
+
+@pytest.mark.rewards
+def test_eval_ckpt_pass_at_k_mixed_tasks(tmp_path):
+    """--k 4 over a mixed math+code set emits pass@1/pass@4/pass^4 for
+    BOTH task kinds (the acceptance-criteria eval shape)."""
+    from areal_tpu.apps.eval_ckpt import evaluate_checkpoint
+    from areal_tpu.base.testing import make_mixed_jsonl
+    from areal_tpu.models import hf as hfmod
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(vocab_size=258)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    hfmod.save_hf_checkpoint(jax.device_get(params), cfg, ckpt)
+    data = str(tmp_path / "mixed.jsonl")
+    make_mixed_jsonl(data, n_math=3, n_code=1)
+    result = evaluate_checkpoint(
+        ckpt, data, max_gen_tokens=8, batch_size=4,
+        mock_tokenizer=True, k=4, temperature=0.8,
+    )
+    assert result["n"] == 4 and result["k"] == 4
+    for key in ("pass@1", "pass@4", "pass^4",
+                "math/pass@1", "math/pass@4", "math/pass^4",
+                "code/pass@1", "code/pass@4", "code/pass^4"):
+        assert key in result, sorted(result)
+        assert 0.0 <= result[key] <= 1.0
+    assert result["math/n"] == 3 and result["code/n"] == 1
+    # estimator coherence on the real output
+    assert result["pass@4"] >= result["pass@1"] >= result["pass^4"]
+    assert result["accuracy"] == result["pass@1"]
 
 
 def test_eval_ckpt_harness_end_to_end(tmp_path):

@@ -709,11 +709,12 @@ def test_chaos_kill_one_of_two_servers_mid_run(tmp_path):
 
 
 @pytest.mark.chaos
-def test_batch_reward_callable_from_running_event_loop(monkeypatch):
-    """Regression: _batch_remote used asyncio.run(), which raises
-    RuntimeError from threads that already run a loop (the async rollout
-    path). With an unreachable service it must fall back to local grading —
-    from sync AND async contexts."""
+def test_batch_reward_event_loop_contract(monkeypatch):
+    """The async rollout path awaits abatch_reward (grading never blocks
+    the loop); the SYNC form now refuses to run on a running loop — the
+    old silent dedicated-thread bridge blocked every in-flight rollout.
+    With an unreachable service both forms fall back to local grading
+    with identical results."""
     from areal_tpu.rewards import client as rclient
 
     monkeypatch.setenv(rclient.SERVICE_ENV, "127.0.0.1:9")
@@ -724,7 +725,9 @@ def test_batch_reward_callable_from_running_event_loop(monkeypatch):
     assert len(sync_scores) == 2
 
     async def inside_loop():
-        return rclient.batch_reward(tasks, max_retries=0)
+        with pytest.raises(RuntimeError, match="abatch_reward"):
+            rclient.batch_reward(tasks, max_retries=0)
+        return await rclient.abatch_reward(tasks, max_retries=0)
 
     async_scores = asyncio.run(inside_loop())
     assert async_scores == sync_scores

@@ -22,7 +22,7 @@ from areal_tpu.api.dfg import (
     build_graph,
 )
 from areal_tpu.base import name_resolve
-from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+from areal_tpu.base.testing import MockTokenizer, make_mixed_jsonl
 
 EXP, TRIAL = "asyncppo", "t0"
 TINY = {"vocab_size": 258, "seed": 0}
@@ -48,6 +48,26 @@ def _serving():
     # Serving engine on (docs/serving.md): the fleet carries rollout
     # traffic AND the interactive probe below through one server.
     return ServingConfig(enabled=True)
+
+
+def _reward_cfg():
+    from areal_tpu.api.train_config import RewardServiceConfig
+
+    # Sandbox reward service on (docs/rewards.md): code rewards grade in
+    # a SEPARATE reward-worker process, never in the rollout process.
+    return RewardServiceConfig(enabled=True, n_workers=1)
+
+
+def _reward_main(nr_root):
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    from areal_tpu.system.reward_worker import RewardWorker, RewardWorkerConfig
+
+    RewardWorker(RewardWorkerConfig(
+        experiment=EXP, trial=TRIAL, worker_index=0,
+        reward=_reward_cfg(), telemetry=_tel(),
+    )).run()
 
 
 def _gen_fleet_main(nr_root, data_path, realloc_dir, flight_dir):
@@ -103,6 +123,9 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir, flight_dir):
             group_size=2, chunk_tokens=4, max_concurrent=4,
             tokenizer=MockTokenizer(), max_rollouts=None,
             telemetry=tel,
+            # Reward grading fans out to the reward worker fleet — this
+            # process must never execute generated code itself.
+            reward_service=_reward_cfg(),
         ))
         await worker.run_async()  # runs until killed
 
@@ -207,7 +230,11 @@ def test_async_ppo_full_loop(tmp_path):
     realloc_dir = str(tmp_path / "realloc")
     jsonl_path = str(tmp_path / "telemetry.jsonl")
     flight_dir = str(tmp_path / "flight")
-    make_math_jsonl(data_path, n=8)
+    # Mixed math+code training data: code-RL rides the SAME async stack
+    # (partial rollout + staleness gate + failover) as math — the
+    # Agent/EnvironmentService contract is the extension point, not a
+    # math-only special case (docs/rewards.md).
+    make_mixed_jsonl(data_path, n_math=6, n_code=2)
     name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_root)
 
     ctx = mp.get_context("spawn")
@@ -216,6 +243,13 @@ def test_async_ppo_full_loop(tmp_path):
     fleet = ctx.Process(target=_gen_fleet_main,
                         args=(nr_root, data_path, realloc_dir, flight_dir),
                         daemon=True)
+    # The sixth worker kind: reward grading in its own sandbox process.
+    # Started FIRST — it is jax-free and registers in well under the time
+    # the fleet takes to come up, so the rollout worker's first grade
+    # already finds the fleet.
+    reward_proc = ctx.Process(target=_reward_main, args=(nr_root,),
+                              daemon=True)
+    reward_proc.start()
     trainer.start()
     fleet.start()
 
@@ -292,11 +326,18 @@ def test_async_ppo_full_loop(tmp_path):
                     f"http://127.0.0.1:{agg_port}/metrics", timeout=5
                 ) as r:
                     body = r.read().decode()
-                for ln in body.splitlines():
-                    if (ln.startswith("areal_trace_e2e_secs_count")
-                            and float(ln.rpartition(" ")[2]) > 0):
-                        merged_scrape.append(body)
-                        return
+                # Capture once the body shows BOTH the stitched trace
+                # histogram AND the reward fleet's request counter — the
+                # "live merged scrape" acceptance for tracing (PR 7) and
+                # the reward service (docs/rewards.md) in one snapshot.
+                trace_ok = any(
+                    ln.startswith("areal_trace_e2e_secs_count")
+                    and float(ln.rpartition(" ")[2]) > 0
+                    for ln in body.splitlines()
+                )
+                if trace_ok and "areal_reward_requests_total" in body:
+                    merged_scrape.append(body)
+                    return
             except Exception:  # noqa: BLE001 — aggregator not up yet
                 pass
             time.sleep(0.3)
@@ -415,6 +456,43 @@ def test_async_ppo_full_loop(tmp_path):
         kinds = {r["worker"].split(":")[0] for r in recs}
         assert len(kinds) >= 3, kinds
         assert any(r["spans"] for r in recs)
+        # --- sandbox reward service proven end to end (docs/rewards.md):
+        # the SIXTH worker kind pushed telemetry to the aggregator...
+        assert "reward" in kinds, kinds
+        # ...graded requests (incl. per-kind verdicts for BOTH task
+        # kinds of the mixed fixture)...
+        reward_counters: dict = {}
+        rollout_counters: dict = {}
+        for r in recs:
+            wk = r["worker"].split(":")[0]
+            tgt = reward_counters if wk == "reward" else (
+                rollout_counters if wk == "rollout" else None
+            )
+            if tgt is not None:
+                for k, v in (r.get("counters") or {}).items():
+                    tgt[k] = tgt.get(k, 0) + v
+        assert reward_counters.get("reward/requests", 0) > 0, reward_counters
+        assert any(k.startswith("reward/verdicts{task=math")
+                   for k in reward_counters), reward_counters
+        assert any(k.startswith("reward/verdicts{task=code")
+                   for k in reward_counters), reward_counters
+        # ...while the ROLLOUT process executed ZERO generated code: every
+        # code grade went over HTTP (remote counter), none ran locally.
+        assert rollout_counters.get("reward_client/remote", 0) > 0, \
+            rollout_counters
+        assert not any("local_graded" in k for k in rollout_counters), \
+            rollout_counters
+        # the reward worker's own Prometheus endpoint serves the verdict
+        # surface directly (the fleet-member contract)
+        from areal_tpu.base import names as _nm
+
+        (rw_url,) = name_resolve.get_subtree(
+            _nm.reward_worker_root(EXP, TRIAL)
+        )
+        with urllib.request.urlopen(f"{rw_url}/metrics", timeout=10) as r:
+            rprom = r.read().decode()
+        assert "areal_reward_requests_total" in rprom
+        assert 'task="code"' in rprom
         # the interactive probe must have finished BEFORE the scrapes
         # below — its histograms/counters are part of what we assert on.
         probe.join(timeout=60)
@@ -479,9 +557,13 @@ def test_async_ppo_full_loop(tmp_path):
         # the REAL merged Prometheus scrape (captured live) carries the
         # prompt→trained latency histogram with nonzero counts
         scraper.join(timeout=60)
-        assert merged_scrape, "merged /metrics never showed trace metrics"
+        assert merged_scrape, \
+            "merged /metrics never showed trace + reward metrics"
         assert "# TYPE areal_trace_e2e_secs histogram" in merged_scrape[0]
         assert "areal_trace_stage_train_wait_secs_bucket" in merged_scrape[0]
+        # the LIVE merged scrape carries the reward fleet's counters
+        # (acceptance: reward_requests_total on the merged endpoint)
+        assert "areal_reward_requests_total" in merged_scrape[0]
         # --- flight recorder: killing a generation server mid-run leaves
         # crash evidence (SIGTERM hook dumps each worker's ring) ---
         assert fleet.is_alive()
@@ -495,8 +577,9 @@ def test_async_ppo_full_loop(tmp_path):
         assert frecs and frecs[-1]["kind"] == "dump"
         assert frecs[-1]["reason"] == "sigterm"
     finally:
-        for p in (trainer, fleet):
+        for p in (trainer, fleet, reward_proc):
             if p.is_alive():
                 p.terminate()
         trainer.join(timeout=10)
         fleet.join(timeout=10)
+        reward_proc.join(timeout=10)

@@ -77,6 +77,14 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       queue depth, and the distinct
                                       compiled-shape count (VERDICT #9,
                                       docs/serving.md)
+  reward-bench <exp> <trial> [n]      fan N mixed math/code tasks at a
+                                      LIVE reward fleet (discovered via
+                                      name-resolve) and report p50/p99
+                                      grade latency per task kind plus
+                                      the fleet-side verdict distribution
+                                      from the merged Prometheus scrape
+                                      (docs/rewards.md); also accepts one
+                                      worker url: reward-bench <url> [n]
   profile-trigger <exp> <trial> <dir> [secs]
                                       ask the live trainer for an
                                       on-demand jax.profiler capture
@@ -212,6 +220,129 @@ def decode_bench(server_url: str, n_requests: int = 24,
           f"kv_states={m.get('kv_states')} "
           f"queue_depth={m.get('queue_depth')} "
           f"prefill_tokens={m.get('prefill_tokens')}")
+
+
+def reward_bench(exp_or_url: str, trial: str = "",
+                 n_tasks: int = 32) -> None:
+    """Grade-latency probe against a LIVE reward fleet (docs/rewards.md):
+    fan a mixed math/code synthetic workload through the real fanout
+    client (bounded concurrency + retry across replicas), report client-
+    side p50/p99 per task kind, then the fleet's own verdict counters
+    from the merged Prometheus scrape (falling back to per-worker
+    /metrics when the aggregator endpoint is absent). jax-free."""
+    import asyncio
+    import json as _json
+    import random
+    import time as _time
+    import urllib.request
+
+    from areal_tpu.api.train_config import RewardServiceConfig
+    from areal_tpu.rewards.client import RewardServiceClient
+
+    if exp_or_url.startswith("http"):
+        urls = [exp_or_url.rstrip("/")]
+    else:
+        from areal_tpu.system.reward_worker import resolve_fleet
+
+        urls = resolve_fleet(exp_or_url, trial)
+        if not urls:
+            sys.exit(
+                f"reward-bench: no reward workers registered for "
+                f"{exp_or_url}/{trial}.\nEither the fleet is down or the "
+                f"service is disabled — relaunch with "
+                f"reward_service.enabled=true, or probe one worker "
+                f"directly: reward-bench <url>."
+            )
+    print(f"[reward-bench] fleet: {len(urls)} worker(s)")
+    rng = random.Random(0)
+    tasks = []
+    for i in range(n_tasks):
+        if i % 4 == 3:  # 1/4 code, 3/4 math — roughly the mixed-data shape
+            k = rng.randint(1, 9)
+            ok = rng.random() < 0.5
+            code = (f"```python\nx = int(input())\nprint(x + "
+                    f"{k if ok else k + 1})\n```")
+            tasks.append({"task": "code", "generated": code,
+                          "input_output": _json.dumps({
+                              "inputs": ["1\n", "2\n"],
+                              "outputs": [f"{1 + k}\n", f"{2 + k}\n"],
+                          })})
+        else:
+            v = rng.randint(0, 999)
+            guess = v if rng.random() < 0.5 else v + 1
+            tasks.append({"task": "math",
+                          "generated": f"\\boxed{{{guess}}}",
+                          "solutions": [f"\\boxed{{{v}}}"]})
+
+    # local_fallback OFF: a dead fleet must surface as 0.0-scored errors
+    # and missing verdict counters, not silently benchmark local grading
+    # on the operator's machine.
+    client = RewardServiceClient(
+        RewardServiceConfig(enabled=True, local_fallback=False), urls=urls
+    )
+    lats = {"math": [], "code": []}
+
+    async def run():
+        import aiohttp
+
+        sem = asyncio.Semaphore(16)
+
+        async def one(session, t):
+            t0 = _time.monotonic()
+            s = await client.grade_one(session, t, sem)
+            lats[t["task"]].append(_time.monotonic() - t0)
+            return s
+
+        async with aiohttp.ClientSession() as session:
+            t0 = _time.monotonic()
+            scores = await asyncio.gather(
+                *[one(session, t) for t in tasks]
+            )
+            return scores, _time.monotonic() - t0
+
+    scores, wall = asyncio.run(run())
+    print(f"[reward-bench] {n_tasks} tasks in {wall:.2f}s -> "
+          f"{n_tasks / max(wall, 1e-9):.1f} grades/s, "
+          f"mean score {sum(scores) / len(scores):.3f}")
+    for kind in ("math", "code"):
+        ls = sorted(lats[kind])
+        if ls:
+            print(f"[reward-bench] {kind:<5} n={len(ls)} "
+                  f"p50={ls[len(ls) // 2] * 1e3:.1f}ms "
+                  f"p99={ls[min(int(0.99 * len(ls)), len(ls) - 1)] * 1e3:.1f}ms")
+    # fleet-side verdict distribution: merged scrape when available,
+    # per-worker /metrics otherwise
+    bodies = []
+    if trial:
+        from areal_tpu.base import name_resolve, names
+
+        try:
+            murl = name_resolve.get(names.telemetry_http(exp_or_url, trial))
+            with urllib.request.urlopen(f"{murl}/metrics", timeout=10) as r:
+                bodies = [("merged", r.read().decode())]
+        except Exception:  # noqa: BLE001 — aggregator absent: per-worker
+            pass
+    if not bodies:
+        for u in urls:
+            try:
+                with urllib.request.urlopen(f"{u}/metrics", timeout=10) as r:
+                    bodies.append((u, r.read().decode()))
+            except Exception as e:  # noqa: BLE001 — worker died mid-bench
+                print(f"[reward-bench] scrape {u} failed: {e}")
+    verdicts = {}
+    for src, body in bodies:
+        for ln in body.splitlines():
+            if ln.startswith("areal_reward_verdicts_total{"):
+                labels, _, val = ln.rpartition(" ")
+                verdicts[labels] = verdicts.get(labels, 0.0) + float(val)
+    if verdicts:
+        print(f"[reward-bench] fleet verdicts "
+              f"({'merged scrape' if bodies[0][0] == 'merged' else 'per-worker'}):")
+        for k, v in sorted(verdicts.items()):
+            print(f"  {k} {v:g}")
+    else:
+        print("[reward-bench] no verdict counters scraped "
+              "(telemetry disabled on the fleet?)")
 
 
 def scrape_fleet(experiment: str, trial: str) -> None:
@@ -604,7 +735,7 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "flight-dump", "packfill", "blocksweep",
                                    "profile-trigger", "profile-status",
                                    "fleet-status", "drain", "cordon",
-                                   "uncordon"):
+                                   "uncordon", "reward-bench"):
         return False
     cmd = argv[0]
     try:
@@ -632,6 +763,13 @@ def _dispatch_fleet_commands(argv) -> bool:
                 int(argv[2]) if len(argv) > 2 else 24,
                 int(argv[3]) if len(argv) > 3 else 32,
             )
+        elif cmd == "reward-bench":
+            if argv[1].startswith("http"):
+                reward_bench(argv[1],
+                             n_tasks=int(argv[2]) if len(argv) > 2 else 32)
+            else:
+                reward_bench(argv[1], argv[2],
+                             int(argv[3]) if len(argv) > 3 else 32)
         elif cmd == "packfill":
             packfill(argv[1:])
         elif cmd == "blocksweep":
