@@ -267,6 +267,12 @@ def make_advantage_prep(hp: PPOHyperparameters):
         )
         out_scalars = {
             "_mean_kl": kl.sum() / jnp.maximum(amf.sum(), 1.0),
+            # Advantage scale BEFORE whitening (post-norm it is ~1 by
+            # construction): a collapsing or exploding raw advantage is a
+            # reward/value-pipeline divergence signature the sentinel
+            # watches as train/adv_scale.
+            "_adv_scale": jnp.sum(jnp.abs(adv) * amf)
+                          / jnp.maximum(amf.sum(), 1.0),
         }
         if hp.adv_norm:
             adv = F.masked_normalization(adv, amask)
@@ -410,6 +416,7 @@ class PPOActorInterface(ModelInterface):
         agg: Dict[str, float] = {}
         n_steps = 0
         mean_kl = 0.0
+        adv_scale = 0.0
 
         if not hp.group_adv_norm and hasattr(engine, "upload_uniform"):
             # Fast path: ONE h2d upload of the whole batch, GAE + advantage
@@ -442,9 +449,11 @@ class PPOActorInterface(ModelInterface):
                 stats = engine.train_uniform(
                     ub, self._loss_fn, _action_token_weight, mb_indices=g,
                     skip_update_rule=skip_rule,
-                    extra_fetch={"_mean_kl": scalars["_mean_kl"]},
+                    extra_fetch={"_mean_kl": scalars["_mean_kl"],
+                                 "_adv_scale": scalars["_adv_scale"]},
                 )
                 mean_kl = stats.pop("_mean_kl")
+                adv_scale = stats.pop("_adv_scale")
                 n_steps += 1
                 for key, v in stats.items():
                     agg[key] = agg.get(key, 0.0) + float(v)
@@ -460,6 +469,13 @@ class PPOActorInterface(ModelInterface):
         else:
             extra = compute_advantages_and_returns(data, hp, self.kl_ctl.value)
             mean_kl = extra.pop("_mean_kl")
+            # Raw advantage scale (pre-whitening), mirroring the device
+            # prep's _adv_scale: the prompt-mask approximation of the
+            # action mask is exact here — doc-first-token advantages are
+            # 0 by construction.
+            am = (1 - np.asarray(data.data["prompt_mask"])) > 0
+            if am.any():
+                adv_scale = float(np.abs(extra["advantages"][am]).mean())
             data = attach_keys(data, extra)
             if hp.adv_norm or hp.group_adv_norm:
                 normalize_advantages(data, hp)
@@ -493,12 +509,24 @@ class PPOActorInterface(ModelInterface):
                     )
                     break
         self.kl_ctl.update(mean_kl, n_steps=1)
+        # Version-staleness of the TRAINED batch (how many publishes
+        # behind the samples' generation weights are) — measured before
+        # this step's version bump, in the same sample units the
+        # staleness gate budgets (max_head_offpolicyness).
+        staleness = 0.0
+        if "version_start" in data.keys:
+            staleness = float(
+                model.version.global_step
+                - np.mean(np.asarray(data.data["version_start"],
+                                     np.float64))
+            )
         model.inc_version()
         n = max(agg.get("n_action_tokens", 1.0), 1.0)
         moe_stats = {
             k: v / max(n_steps, 1) for k, v in agg.items()
             if k.startswith("moe_")
         }
+        rewards_np = np.asarray(data.data["rewards"], np.float32).reshape(-1)
         return {
             **moe_stats,
             "actor_loss": agg.get("loss", 0.0),
@@ -511,7 +539,17 @@ class PPOActorInterface(ModelInterface):
             "lr": agg.get("lr", 0.0) / max(n_steps, 1),
             "n_action_tokens": agg.get("n_action_tokens", 0.0),
             "n_ppo_steps": float(n_steps),
-            "task_reward": float(np.mean(np.asarray(data.data["rewards"]))),
+            "task_reward": float(rewards_np.mean()),
+            # Training-dynamics divergence signatures (first-class
+            # telemetry via trainer_worker._export_train_stats; the
+            # sentinel's default rule pack keys off these —
+            # docs/observability.md §Alerting).
+            "approx_kl": agg.get("approx_kl_sum", 0.0) / n,
+            "entropy": agg.get("entropy_sum", 0.0) / n,
+            "behav_imp_tail": agg.get("behav_tail_sum", 0.0) / n,
+            "reward_std": float(rewards_np.std()),
+            "adv_scale": float(adv_scale),
+            "staleness_lag": staleness,
         }
 
     def save(self, model: Model, save_dir: str) -> None:

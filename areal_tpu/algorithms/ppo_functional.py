@@ -243,11 +243,17 @@ def actor_loss(
         loss_tok = jnp.where(dual_mask, dual, loss_tok)
     else:
         dual_clip_mask = jnp.zeros_like(clip_mask)
+    # Importance-weight tail: the mass of action tokens the behaviour
+    # cap DROPS — off-policyness beyond what the decoupled loss corrects,
+    # one of the divergence signatures the training-health sentinel
+    # watches (system/sentinel.py).
+    behav_tail = jnp.zeros((), jnp.float32)
     if proximal_logprobs is not None:
         behav_w = jnp.exp(jnp.where(mask, center - old_logprobs, 0.0))
         if behav_imp_weight_cap is not None:
             # Reference drops tokens whose weight exceeds the cap.
             keep = behav_w <= behav_imp_weight_cap
+            behav_tail = jnp.sum((~keep) & mask) / denom
             behav_w = jnp.where(keep, behav_w, 0.0)
         loss_tok = loss_tok * behav_w
     loss = jnp.sum(jnp.where(mask, loss_tok, 0.0)) / denom
@@ -255,6 +261,15 @@ def actor_loss(
         "importance_weight": jnp.sum(ratio * mask) / denom,
         "clip_ratio": jnp.sum(clip_mask) / denom,
         "dual_clip_ratio": jnp.sum(dual_clip_mask) / denom,
+        # Training-dynamics series (exported per step as train/* gauges):
+        # k1 approx-KL of the current policy against the BEHAVIOUR policy
+        # (the thing PPO's trust region bounds), and the sampled-token
+        # entropy estimate −E[log π(a_t)] — cheap under the chunked
+        # logprob head, where the full distribution is never materialized.
+        "approx_kl": jnp.sum(jnp.where(mask, old_logprobs - logprobs, 0.0))
+                     / denom,
+        "entropy": -jnp.sum(jnp.where(mask, logprobs, 0.0)) / denom,
+        "behav_tail": behav_tail,
     }
     return loss, stats
 

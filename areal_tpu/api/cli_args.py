@@ -37,6 +37,7 @@ from areal_tpu.api.train_config import (  # noqa: F401
     FaultToleranceConfig,
     OptimizerConfig,
     RewardServiceConfig,
+    SentinelConfig,
     ServingConfig,
     TelemetryConfig,
     WeightSyncConfig,
@@ -212,6 +213,16 @@ class BaseExperimentConfig:
     # rollout trace spans, Prometheus /metrics, and profiler triggers.
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
+    )
+    # Training-health sentinel (docs/observability.md §Alerting): off by
+    # default — `sentinel.enabled=true` (with telemetry on) arms the
+    # master-hosted rule engine: streaming anomaly detection over fleet
+    # telemetry + per-step training dynamics, alerts.jsonl +
+    # areal_alerts_total on the merged scrape, automatic evidence capture
+    # (flight dumps, pinned traces, optional profiler), autoscale-inhibit
+    # on critical alerts, and opt-in master pause.
+    sentinel: SentinelConfig = dataclasses.field(
+        default_factory=SentinelConfig
     )
     # Generation-fleet serving engine (docs/serving.md): off by default —
     # `serving.enabled=true` turns on request-class admission control,
@@ -481,6 +492,32 @@ def validate_config(cfg) -> None:
                 f"serving.min_rollout_share={share} must be in [0, 1] "
                 f"(fraction of each batch reserved for rollout traffic)"
             )
+    sn = getattr(cfg, "sentinel", None)
+    if sn is not None and getattr(sn, "enabled", False):
+        tel = getattr(cfg, "telemetry", None)
+        if tel is None or not getattr(tel, "enabled", False):
+            raise ConfigError(
+                "sentinel.enabled=true requires telemetry.enabled=true: "
+                "the sentinel lives inside the master's "
+                "TelemetryAggregator and evaluates the merged fleet "
+                "snapshots — without telemetry there is nothing to watch "
+                "(docs/observability.md §Alerting)"
+            )
+        if getattr(sn, "eval_interval_secs", 1.0) <= 0:
+            raise ConfigError(
+                f"sentinel.eval_interval_secs="
+                f"{sn.eval_interval_secs} must be > 0"
+            )
+        # Front-run the exact rule-pack construction the master will do:
+        # unknown metric names, non-positive for:/cooldown durations, and
+        # duplicate rule ids must fail at the command line, naming the
+        # offending rule — not inside a spawned master worker.
+        from areal_tpu.system.sentinel import rules_from_config
+
+        try:
+            rules_from_config(sn)
+        except ValueError as e:
+            raise ConfigError(f"invalid sentinel rule pack: {e}") from None
     rs = getattr(cfg, "reward_service", None)
     if rs is not None and getattr(rs, "enabled", False):
         if rs.n_workers < 1:

@@ -13,7 +13,7 @@ Parity targets: reference ``cli_args.py:173`` (OptimizerConfig) and
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -90,6 +90,64 @@ class TelemetryConfig:
     # None: no crash hooks are installed (on-demand dumps still work —
     # the trigger request carries its own directory).
     flight_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SentinelConfig:
+    """Training-health sentinel (system/sentinel.py,
+    docs/observability.md §Alerting).
+
+    Off by default: nothing is constructed — zero threads, sockets, or
+    allocations, and the merged Prometheus scrape is bit-identical to a
+    build without the sentinel. Enabled (requires ``telemetry.enabled``),
+    the master's TelemetryAggregator hosts a rule engine that evaluates a
+    declarative rule pack (threshold / rate-of-change /
+    rolling-baseline-deviation / absence-of-signal predicates, each with
+    a ``for:`` hold duration, severity, and per-rule cooldown) over the
+    merged fleet telemetry and the trainer's per-step training-dynamics
+    series. Firing alerts land in ``alerts.jsonl``, export as
+    ``areal_alerts_total{rule,severity}`` / ``areal_alert_active`` on the
+    merged scrape, and capture evidence (fleet flight dumps, pinned trace
+    ids, the triggering metric window, optional profiler capture) into
+    ``evidence/<rule>-<ts>/`` while the anomaly is still live."""
+
+    enabled: bool = False
+    # Rule evaluation cadence inside the aggregator's ingest loop.
+    eval_interval_secs: float = 1.0
+    # Include the built-in divergence-signature rule pack
+    # (system/sentinel.DEFAULT_RULES; table in docs/observability.md).
+    default_rules: bool = True
+    # Extra rules (dicts in the rule grammar; validated at parse time —
+    # unknown metrics, non-positive durations, and duplicate ids are
+    # rejected with an error naming the rule). Primarily set via YAML.
+    rules: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # A source (one worker's reading of a metric) that has not reported
+    # a value within this window is dropped from rule aggregation — a
+    # scaled-down/evicted worker's last gauge must not pin a max/sum
+    # aggregate (and a false alert) forever.
+    source_expiry_secs: float = 120.0
+    # Alert stream; defaults next to telemetry.jsonl.
+    alerts_path: Optional[str] = None
+    # Per-alert evidence bundles; defaults to <log>/evidence.
+    evidence_dir: Optional[str] = None
+    # Hard cap on bundles per run (beyond it alerts still fire and
+    # export, but capture is skipped and counted).
+    max_evidence_bundles: int = 8
+    # Critical alerts also request an on-demand jax.profiler capture on
+    # the trainer into the bundle (off by default: a capture costs real
+    # trainer time exactly when the run is struggling).
+    profile_on_critical: bool = False
+    profile_secs: float = 5.0
+    # How many recent stitched trace ids to pin into each bundle.
+    pinned_traces: int = 8
+    # Rules with action=pause may command a master pause at the next
+    # step boundary (WorkerControl panel). Off by default — an operator
+    # must opt into the sentinel stopping a run.
+    allow_pause: bool = False
+    # Critical alerts publish an autoscale-inhibit hint so the fleet
+    # does not scale up into a diverging run (system/autoscaler).
+    autoscale_inhibit: bool = True
+    inhibit_secs: float = 300.0
 
 
 @dataclasses.dataclass

@@ -149,6 +149,26 @@ def autoscale_plan(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/autoscale_plan"
 
 
+def autoscale_inhibit(experiment: str, trial: str) -> str:
+    """Autoscale-inhibit hint published by the training-health sentinel
+    on critical alerts (JSON {until, rule, ts}): while live, the gserver
+    manager's scaling loop suppresses scale-up — growing the fleet into
+    a diverging run only burns capacity (system/sentinel.py,
+    system/autoscaler.read_inhibit)."""
+    return f"{_base(experiment, trial)}/autoscale_inhibit"
+
+
+def sentinel_silence(experiment: str, trial: str, rule: str) -> str:
+    """Operator silence for one sentinel rule (JSON {until, rule}):
+    written by ``tools/perf_probe.py silence <rule> <duration>``; the
+    sentinel suppresses the rule's fires until it expires."""
+    return f"{_base(experiment, trial)}/sentinel_silence/{rule}"
+
+
+def sentinel_silence_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/sentinel_silence/"
+
+
 def drain_status(experiment: str, trial: str) -> str:
     """Graceful-drain phase marker written by supervisor.drain_experiment
     (JSON {phase, ts}): pausing -> checkpoint -> exiting -> done. Read by

@@ -877,6 +877,14 @@ class TraceStitcher:
                 "spans": spans,
             }) + "\n")
 
+    def recent_trace_ids(self, n: int = 8) -> List[str]:
+        """The most recently touched trace ids (newest last) — the
+        sentinel pins these into alert evidence bundles so the operator
+        can replay the samples that were in flight when an anomaly
+        fired (tools/perf_probe.py trace <traces.jsonl> <id>)."""
+        with self._lock:
+            return list(self._traces)[-max(int(n), 0):]
+
     def close(self) -> None:
         self.tick(force=True)
         if self._file is not None:
@@ -899,10 +907,16 @@ class TelemetryAggregator:
                  jsonl_path: Optional[str] = None,
                  metric_writer=None, http_port: int = 0,
                  traces_path: Optional[str] = None,
-                 stitch_grace_secs: float = 5.0):
+                 stitch_grace_secs: float = 5.0,
+                 sentinel=None):
         import zmq
 
         self.jsonl_path = jsonl_path
+        # Optional training-health sentinel (system/sentinel.Sentinel):
+        # fed every ingested snapshot's gauges/counters and ticked from
+        # the ingest loop — it owns no thread of its own. None (the
+        # default) leaves ingest and the merged scrape bit-identical.
+        self.sentinel = sentinel
         self._writer = metric_writer
         self._seq = 0
         self.state: Dict[str, Dict[str, Any]] = {}
@@ -919,6 +933,10 @@ class TelemetryAggregator:
         self.traces_path = traces_path
         self.stitcher = TraceStitcher(traces_path,
                                       grace_secs=stitch_grace_secs)
+        if self.sentinel is not None \
+                and getattr(self.sentinel, "stitcher", None) is None:
+            # Evidence bundles pin recent stitched trace ids.
+            self.sentinel.stitcher = self.stitcher
         self._sock = zmq.Context.instance().socket(zmq.PULL)
         self._sock.setsockopt(zmq.RCVHWM, 4096)
         port = self._sock.bind_to_random_port(f"tcp://{network.bind_addr()}")
@@ -959,6 +977,18 @@ class TelemetryAggregator:
             self._seq += 1
             seq = self._seq
         self.stitcher.feed(worker, spans)
+        if self.sentinel is not None:
+            try:
+                # Full "kind:index" identity: same-kind workers must be
+                # DISTINCT sources or cross-worker agg (max/mean/sum)
+                # collapses to whichever worker pushed last.
+                self.sentinel.feed(
+                    worker,
+                    payload.get("gauges", {}),
+                    payload.get("counters", {}),
+                )
+            except Exception as e:  # noqa: BLE001 — watcher never kills
+                logger.warning(f"sentinel feed failed: {e}")
         if self._jsonl_file is not None:
             rec = {"worker": worker, **{
                 k: payload.get(k) for k in
@@ -984,8 +1014,12 @@ class TelemetryAggregator:
                 if self._sock.poll(100):
                     self._ingest(pickle.loads(self._sock.recv()))
                 # Deferred stitches come due on wall time, not on new
-                # snapshots — run them on idle poll timeouts too.
+                # snapshots — run them on idle poll timeouts too. Same
+                # for the sentinel: absence-of-signal rules and `for:`
+                # windows elapse without any snapshot arriving.
                 self.stitcher.tick()
+                if self.sentinel is not None:
+                    self.sentinel.tick()
             except Exception as e:  # noqa: BLE001 — aggregator must survive
                 if not self._closing.is_set():
                     logger.warning(f"telemetry ingest failed: {e}")
@@ -1019,6 +1053,12 @@ class TelemetryAggregator:
         stitched = self.stitcher.registry.snapshot(reset=False)
         if stitched["counters"] or stitched["hists"]:
             rows["aggregator:0"] = stitched
+        if self.sentinel is not None:
+            # areal_alerts_total{rule,severity} + areal_alert_active join
+            # the merged exposition as the sentinel pseudo-worker.
+            sn = self.sentinel.registry.snapshot(reset=False)
+            if sn["counters"] or sn["gauges"]:
+                rows["sentinel:0"] = sn
         for worker, st in sorted(rows.items()):
             kind, _, idx = worker.partition(":")
             labels = {"worker_kind": kind, "worker_index": idx}
@@ -1121,6 +1161,8 @@ class TelemetryAggregator:
         if self._jsonl_file is not None:
             self._jsonl_file.close()
         self.stitcher.close()
+        if self.sentinel is not None:
+            self.sentinel.close()
 
 
 # --------------------------------------------------------------------------

@@ -375,6 +375,9 @@ class PPOMATHConfig(BaseExperimentConfig):
             ),
             wandb_mode=self.wandb.mode,
             telemetry=tel,
+            # Training-health sentinel rides in the master's aggregator;
+            # its alerts.jsonl/evidence default next to telemetry.jsonl.
+            sentinel=self.sentinel,
             recover_dir=paths["recover"],
             recover=self.recover_mode == "resume",
         )

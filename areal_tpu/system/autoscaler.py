@@ -75,6 +75,11 @@ class FleetSignals:
     slo_miss_frac: float = 0.0  # fraction of servers over the TTFC SLO
     fanout_ack_secs: float = 0.0  # last weight-fanout ack latency
     stale_heartbeats: int = 0  # servers alive-but-wedged per liveness lease
+    # The training-health sentinel published an autoscale-inhibit hint
+    # (critical alert live — system/sentinel.py): scale-up is suppressed,
+    # since growing the fleet into a diverging run only burns capacity
+    # and deepens off-policyness. Scale-down stays allowed.
+    inhibited: bool = False
 
 
 class AutoscalerCore:
@@ -101,7 +106,7 @@ class AutoscalerCore:
 
     def _up_reasons(self, s: FleetSignals) -> List[str]:
         c = self.cfg
-        if s.staled:
+        if s.staled or s.inhibited:
             return []
         reasons = []
         if s.utilization >= c.up_utilization:
@@ -283,6 +288,24 @@ def read_plan(experiment: str, trial: str) -> Optional[Dict]:
             names.autoscale_plan(experiment, trial)
         ))
     except Exception:  # noqa: BLE001 — no plan yet / torn write
+        return None
+
+
+def read_inhibit(experiment: str, trial: str,
+                 wall: Callable[[], float] = time.time) -> Optional[Dict]:
+    """The sentinel's autoscale-inhibit hint ({until, rule, ts}), or None
+    when absent/expired. Consumed by the manager's scaling loop each
+    interval; expiry means a stale hint from a resolved incident can
+    never pin the fleet forever."""
+    try:
+        d = json.loads(name_resolve.get(
+            names.autoscale_inhibit(experiment, trial)
+        ))
+    except Exception:  # noqa: BLE001 — no hint published
+        return None
+    try:
+        return d if wall() < float(d.get("until", 0.0)) else None
+    except (TypeError, ValueError):
         return None
 
 

@@ -703,6 +703,21 @@ class GserverManager:
         ac = self.cfg.autoscale
         stale_urls = self._stale_heartbeat_urls(list(self.servers))
         sig = self._autoscale_signals(stale_urls)
+        # Sentinel autoscale-inhibit hint (critical training-health alert
+        # live): suppress scale-up for its duration — more decode
+        # capacity cannot fix a diverging trainer, it only deepens
+        # off-policyness (system/sentinel.py, docs/observability.md).
+        inhibit = autoscale_mod.read_inhibit(
+            self.cfg.experiment, self.cfg.trial
+        )
+        sig.inhibited = inhibit is not None
+        self.telemetry.set_gauge("autoscale/inhibited",
+                                 1.0 if inhibit else 0.0)
+        if inhibit:
+            logger.debug(
+                f"autoscale: scale-up inhibited by sentinel rule "
+                f"{inhibit.get('rule')!r}"
+            )
         action = self.autoscaler.observe(sig)
         self._overloaded = self.autoscaler.overloaded
         if action is not None:

@@ -42,6 +42,7 @@ logger = logging.getLogger("system.master")
 # because this module historically defined it.
 from areal_tpu.api.train_config import (  # noqa: E402,F401
     ExperimentSaveEvalControl,
+    SentinelConfig,
     TelemetryConfig,
 )
 
@@ -66,6 +67,12 @@ class MasterWorkerConfig:
     # optional Prometheus http port). Off by default.
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
+    )
+    # Training-health sentinel (system/sentinel.py): hosted inside the
+    # aggregator above; requires telemetry. Off by default — nothing is
+    # constructed and the merged scrape is bit-identical.
+    sentinel: SentinelConfig = dataclasses.field(
+        default_factory=SentinelConfig
     )
     # recover checkpoints (RecoverInfo + trainer train-state) live here
     recover_dir: str = ""
@@ -122,6 +129,7 @@ class MasterWorker:
         # it, and before the master's own telemetry configures — so it is
         # the first telemetry object up. Disabled config: nothing starts.
         self._aggregator = None
+        self._sentinel = None
         if self.cfg.telemetry.enabled:
             import os
 
@@ -133,6 +141,22 @@ class MasterWorker:
                 if self.cfg.tensorboard_path else self.cfg.save_dir,
                 "telemetry.jsonl",
             )
+            if self.cfg.sentinel.enabled:
+                # Training-health sentinel (docs/observability.md
+                # §Alerting): hosted in the aggregator below — fed every
+                # ingested snapshot, ticked from the ingest loop, no
+                # threads of its own. alerts.jsonl and the evidence dir
+                # default next to telemetry.jsonl.
+                from areal_tpu.system.sentinel import Sentinel
+
+                log_dir = os.path.dirname(jsonl) or "."
+                self._sentinel = Sentinel(
+                    self.cfg.sentinel, self.cfg.experiment, self.cfg.trial,
+                    alerts_path=(self.cfg.sentinel.alerts_path
+                                 or os.path.join(log_dir, "alerts.jsonl")),
+                    evidence_dir=(self.cfg.sentinel.evidence_dir
+                                  or os.path.join(log_dir, "evidence")),
+                )
             self._aggregator = telemetry.TelemetryAggregator(
                 self.cfg.experiment, self.cfg.trial, jsonl_path=jsonl,
                 http_port=self.cfg.telemetry.http_port,
@@ -140,6 +164,7 @@ class MasterWorker:
                 # sample); defaults next to telemetry.jsonl.
                 traces_path=self.cfg.telemetry.traces_path,
                 stitch_grace_secs=self.cfg.telemetry.stitch_grace_secs,
+                sentinel=self._sentinel,
             )
             telemetry.configure(
                 self.cfg.experiment, self.cfg.trial, "master", 0,
@@ -391,6 +416,21 @@ class MasterWorker:
                     step_stats["timeperf/mfu"] = per_chip / self._peak_flops
             self._stats_history.append(step_stats)
             self._writer.write(step_stats, self.step)
+            # Step wall time on the scrape (throughput-regression rules)
+            # and a DIRECT sentinel feed: the master hosts the engine
+            # in-process, so its per-step series skip the flush latency
+            # every other worker's snapshots pay. Feed only — rule
+            # evaluation (and its evidence-capture I/O) belongs to the
+            # aggregator's ingest thread, never the step loop.
+            telemetry.set_gauge("master/step_secs", dt)
+            if self._sentinel is not None:
+                # Same "kind:index" identity the flushed copy arrives
+                # under, so the direct feed and the aggregator ingest
+                # share ONE source slot instead of double-counting.
+                self._sentinel.feed("master:0", {
+                    "master/step_secs": dt,
+                    "master/step": float(self.step),
+                })
             logger.info(
                 f"step {self.step} epoch {self.epoch} "
                 f"({step_stats['timeperf/e2e']:.2f}s): "

@@ -344,12 +344,17 @@ class RolloutWorker:
                     # versions elapsed while this trajectory generated —
                     # the decoupled-loss off-policyness the staleness gate
                     # is supposed to bound.
+                    lag = float(np.asarray(t.data["version_end"])[0]
+                                - np.asarray(t.data["version_start"])[0])
                     telemetry.observe(
-                        "rollout/staleness_lag",
-                        float(np.asarray(t.data["version_end"])[0]
-                              - np.asarray(t.data["version_start"])[0]),
+                        "rollout/staleness_lag", lag,
                         buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0),
                     )
+                    # Last-value gauge alongside the histogram: the
+                    # sentinel evaluates scalar series, and a cumulative
+                    # histogram has no "current" reading (distinct name —
+                    # one Prometheus family cannot be both kinds).
+                    telemetry.set_gauge("rollout/staleness_current", lag)
             accepted = len(final)
             self._pushed += accepted
             telemetry.inc("rollout/trajectories_pushed", accepted)

@@ -368,6 +368,7 @@ class TrainerWorker:
         result: Dict[str, Any] = {"stats": None, "meta": None}
         if method == "train_step":
             result["stats"] = out
+            self._export_train_stats(mfc_name, out)
             self._emit_terminal_spans(
                 req["ids"], model, t_mfc_wall, time.monotonic() - t_mfc
             )
@@ -389,6 +390,33 @@ class TrainerWorker:
         for hook in p.post_hooks:
             self._run_hook(hook)
         return result
+
+    # The divergence signatures that kill RL runs get a distribution view
+    # on top of the last-value gauge (suffix _dist: a gauge and a
+    # histogram cannot share one Prometheus family name).
+    _TRAIN_DIST_KEYS = ("approx_kl", "entropy", "grad_norm",
+                        "importance_weight", "clip_ratio")
+
+    def _export_train_stats(self, mfc_name: str,
+                            stats: Optional[Dict[str, Any]]) -> None:
+        """First-class training-dynamics telemetry per train step
+        (docs/observability.md): every train_step scalar becomes a
+        ``train/<name>{mfc=...}`` gauge on the scrape — the sentinel's
+        rule pack and any external Prometheus reader consume THESE, not
+        the stats_tracker/tensorboard keys the master tabulates. No-op
+        with telemetry disabled."""
+        if not stats or not telemetry.enabled():
+            return
+        import math
+
+        for k, v in stats.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or not math.isfinite(v):
+                continue
+            telemetry.set_gauge(f"train/{k}{{mfc={mfc_name}}}", float(v))
+            if k in self._TRAIN_DIST_KEYS:
+                telemetry.observe(f"train/{k}_dist{{mfc={mfc_name}}}",
+                                  float(v))
 
     def _emit_terminal_spans(self, ids, model, t_start: float,
                              dur_secs: float) -> None:

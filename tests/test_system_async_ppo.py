@@ -42,6 +42,29 @@ def _tel():
     return TelemetryConfig(**TEL)
 
 
+def _sentinel(tmp_path):
+    """Sentinel armed on the e2e (docs/observability.md §Alerting): the
+    DEFAULT rule pack rides along — a healthy run must fire zero critical
+    alerts from it — plus one INJECTED anomaly probe. The injection is a
+    hair-trigger threshold on the first train step's gradient signature
+    (the FaultInjector pattern applied to the rule pack: arm a condition
+    no production config would use, observe the full fire → alert →
+    evidence pipeline deterministically inside a 3-step run)."""
+    from areal_tpu.api.train_config import SentinelConfig
+
+    return SentinelConfig(
+        enabled=True, eval_interval_secs=0.1,
+        rules=[{
+            "id": "e2e_divergence_probe", "metric": "train/grad_norm",
+            "kind": "threshold", "op": "gt", "value": 1e-6,
+            "for": 0.2, "cooldown": 600, "severity": "critical",
+            "description": "e2e-injected divergence probe",
+        }],
+        alerts_path=str(tmp_path / "alerts.jsonl"),
+        evidence_dir=str(tmp_path / "evidence"),
+    )
+
+
 def _serving():
     from areal_tpu.api.train_config import ServingConfig
 
@@ -317,10 +340,12 @@ def test_async_ppo_full_loop(tmp_path):
 
     agg_port = network.find_free_port()
     merged_scrape = []
+    sentinel_scrape = []
 
     def _merged_scrape_probe():
         deadline = time.monotonic() + 300
-        while time.monotonic() < deadline and not merged_scrape:
+        while time.monotonic() < deadline \
+                and not (merged_scrape and sentinel_scrape):
             try:
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{agg_port}/metrics", timeout=5
@@ -335,9 +360,15 @@ def test_async_ppo_full_loop(tmp_path):
                     and float(ln.rpartition(" ")[2]) > 0
                     for ln in body.splitlines()
                 )
-                if trace_ok and "areal_reward_requests_total" in body:
+                if not merged_scrape and trace_ok \
+                        and "areal_reward_requests_total" in body:
                     merged_scrape.append(body)
-                    return
+                # Separate capture for the sentinel acceptance: the fired
+                # alert appears on the LIVE merged scrape as
+                # areal_alerts_total{rule,severity} + areal_alert_active.
+                if not sentinel_scrape \
+                        and "areal_alerts_total" in body:
+                    sentinel_scrape.append(body)
             except Exception:  # noqa: BLE001 — aggregator not up yet
                 pass
             time.sleep(0.3)
@@ -361,6 +392,9 @@ def test_async_ppo_full_loop(tmp_path):
                 ),
                 telemetry=dc.replace(_tel(), jsonl_path=jsonl_path,
                                      http_port=agg_port),
+                # Training-health sentinel armed: default pack (must stay
+                # quiet on this healthy run) + the injected probe.
+                sentinel=_sentinel(tmp_path),
             ),
             _build_async_dfg(),
         )
@@ -564,6 +598,63 @@ def test_async_ppo_full_loop(tmp_path):
         # the LIVE merged scrape carries the reward fleet's counters
         # (acceptance: reward_requests_total on the merged endpoint)
         assert "areal_reward_requests_total" in merged_scrape[0]
+        # --- training-health sentinel (docs/observability.md §Alerting) ---
+        from areal_tpu.system.sentinel import DEFAULT_RULES
+
+        alerts_path = tmp_path / "alerts.jsonl"
+        assert alerts_path.exists(), os.listdir(tmp_path)
+        with open(alerts_path) as f:
+            alert_recs = [_json.loads(ln) for ln in f if ln.strip()]
+        # (1) the DEFAULT pack stayed quiet: zero critical alerts on a
+        # healthy run (conservative thresholds are the contract)
+        default_ids = {r["id"] for r in DEFAULT_RULES}
+        noisy = [r for r in alert_recs
+                 if r.get("event") == "firing"
+                 and r.get("severity") == "critical"
+                 and r.get("rule") in default_ids]
+        assert not noisy, noisy
+        # (2) the injected anomaly fired its rule within the configured
+        # `for:` window and landed in alerts.jsonl...
+        probe = [r for r in alert_recs
+                 if r.get("event") == "firing"
+                 and r.get("rule") == "e2e_divergence_probe"]
+        assert probe, alert_recs
+        assert probe[0]["severity"] == "critical"
+        assert probe[0]["for_secs"] == 0.2
+        assert probe[0]["value"] > 1e-6
+        # ...and on the LIVE merged Prometheus scrape
+        assert sentinel_scrape, \
+            "merged /metrics never showed areal_alerts_total"
+        assert ('areal_alerts_total{rule="e2e_divergence_probe",'
+                'severity="critical"') in sentinel_scrape[0]
+        assert "areal_alert_active" in sentinel_scrape[0]
+        # (3) evidence was captured while the anomaly was live: the
+        # bundle holds the alert + triggering metric window + pinned
+        # traces, and the fan-out flight-dump trigger pulls rings from
+        # the still-running fleet/reward/trainer processes (each worker
+        # acts within one telemetry flush interval).
+        evidence_dir = probe[0].get("evidence_dir")
+        assert evidence_dir and os.path.isdir(evidence_dir), probe[0]
+        with open(os.path.join(evidence_dir, "alert.json")) as f:
+            ev = _json.load(f)
+        assert ev["rule"] == "e2e_divergence_probe"
+        assert ev["metric_window"], ev
+        assert any(p["value"] > 1e-6 for p in ev["metric_window"])
+        assert any(k.startswith("trainer:0|train/grad_norm")
+                   for k in ev["sources"])
+        assert os.path.exists(os.path.join(evidence_dir, "traces.json"))
+
+        def _flight_kinds():
+            return {
+                fn[len("flight_"):].rstrip("0123456789.jsonl") or fn
+                for fn in os.listdir(evidence_dir)
+                if fn.startswith("flight_")
+            }
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(_flight_kinds()) < 2:
+            time.sleep(0.3)
+        assert len(_flight_kinds()) >= 2, os.listdir(evidence_dir)
         # --- flight recorder: killing a generation server mid-run leaves
         # crash evidence (SIGTERM hook dumps each worker's ring) ---
         assert fleet.is_alive()

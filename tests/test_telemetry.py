@@ -210,6 +210,7 @@ def _fake_agg_render(snap):
         stitcher=types.SimpleNamespace(registry=types.SimpleNamespace(
             snapshot=lambda reset=False: empty,
         )),
+        sentinel=None,
     )
     return T.TelemetryAggregator.render_prometheus(fake)
 

@@ -85,6 +85,23 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       from the merged Prometheus scrape
                                       (docs/rewards.md); also accepts one
                                       worker url: reward-bench <url> [n]
+  alerts <exp> <trial> [severity] [rule]
+                                      training-health sentinel view of a
+                                      LIVE run: alert totals + active
+                                      alerts from the merged Prometheus
+                                      scrape, optionally filtered by
+                                      severity (info|warn|critical) or
+                                      rule id (docs/observability.md
+                                      §Alerting)
+  alerts <alerts.jsonl> [severity] [rule]
+                                      same filters over a run's recorded
+                                      alert stream (works after the run
+                                      is dead — post-mortem triage)
+  silence <exp> <trial> <rule> <dur>  silence one sentinel rule for a
+                                      duration ("30s"/"10m"/"1h"): it
+                                      keeps evaluating but neither fires
+                                      nor captures evidence until the
+                                      silence expires
   profile-trigger <exp> <trial> <dir> [secs]
                                       ask the live trainer for an
                                       on-demand jax.profiler capture
@@ -575,6 +592,117 @@ def drain(experiment: str, trial: str) -> None:
               f"({ck.get('error') or res.get('reason') or 'master absent'})")
 
 
+def alerts(exp_or_path: str, trial: str = "", severity: str = "",
+           rule: str = "") -> None:
+    """Training-health alert view (jax-free): either tail/filter a run's
+    ``alerts.jsonl`` (post-mortem), or pull the live alert counters off
+    the merged Prometheus scrape (docs/observability.md §Alerting)."""
+    import json as _json
+    import os as _os
+    import urllib.request
+
+    # File mode only for an actual alert-stream file: a directory named
+    # after the experiment (launchers create <exp>/ log dirs in cwd)
+    # must still route to the live merged scrape.
+    if _os.path.isfile(exp_or_path) or exp_or_path.endswith(".jsonl"):
+        # file mode: positional args shift left (no trial)
+        severity, rule = trial, severity
+        try:
+            with open(exp_or_path) as f:
+                recs = [_json.loads(ln) for ln in f if ln.strip()]
+        except OSError as e:
+            sys.exit(f"alerts: cannot read {exp_or_path}: {e}")
+        shown = 0
+        for r in recs:
+            if severity and r.get("severity") != severity:
+                continue
+            if rule and r.get("rule") != rule:
+                continue
+            shown += 1
+            ts = time.strftime("%H:%M:%S", time.localtime(r.get("ts", 0)))
+            extra = ""
+            if r.get("event") == "firing":
+                extra = (f"  {r.get('metric')}={r.get('value')}"
+                         + (f"  evidence={r['evidence_dir']}"
+                            if r.get("evidence_dir") else ""))
+            print(f"{ts}  {r.get('severity', '?'):<8} "
+                  f"{r.get('event', '?'):<9} {r.get('rule', '?')}{extra}")
+        print(f"({shown}/{len(recs)} records"
+              + (f", severity={severity}" if severity else "")
+              + (f", rule={rule}" if rule else "") + ")")
+        return
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        url = name_resolve.get(names.telemetry_http(exp_or_path, trial))
+    except Exception:  # noqa: BLE001 — telemetry off / no http port
+        sys.exit(
+            f"alerts: no merged telemetry endpoint for "
+            f"{exp_or_path}/{trial}.\nEither the run is down or telemetry "
+            f"has no http_port — read the recorded stream instead: "
+            f"alerts <log-dir>/alerts.jsonl"
+        )
+    with urllib.request.urlopen(f"{url.rstrip('/')}/metrics",
+                                timeout=10) as r:
+        body = r.read().decode()
+    lines = []
+    for ln in body.splitlines():
+        if not (ln.startswith("areal_alerts_total")
+                or ln.startswith("areal_alert_active")
+                or ln.startswith("areal_sentinel_")):
+            continue
+        # Only alerts_total carries a severity label — filtering the
+        # active/sentinel lines on it would hide every live alert.
+        if severity and ln.startswith("areal_alerts_total") \
+                and f'severity="{severity}"' not in ln:
+            continue
+        if rule and f'rule="{rule}"' not in ln:
+            continue
+        lines.append(ln)
+    if not lines:
+        print("no sentinel metrics on the scrape "
+              "(sentinel disabled, or no rule matched the filters)")
+    for ln in lines:
+        print(f"  {ln}")
+    # active operator silences ride along — an alert that "never fires"
+    # is often just silenced
+    try:
+        now = time.time()
+        for key in name_resolve.find_subtree(
+                names.sentinel_silence_root(exp_or_path, trial)):
+            d = _json.loads(name_resolve.get(key))
+            if float(d.get("until", 0)) > now:
+                print(f"  silenced: {d.get('rule')} for another "
+                      f"{float(d['until']) - now:.0f}s")
+    except Exception:  # noqa: BLE001 — no silences registered
+        pass
+
+
+def silence(experiment: str, trial: str, rule: str, duration: str) -> None:
+    """Silence one sentinel rule for a duration — it keeps evaluating
+    (state machine advances) but fires are suppressed until expiry."""
+    import json as _json
+
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.system.sentinel import parse_duration
+
+    try:
+        secs = parse_duration(duration)
+    except ValueError as e:
+        sys.exit(f"silence: {e}")
+    until = time.time() + secs
+    name_resolve.add(
+        names.sentinel_silence(experiment, trial, rule),
+        _json.dumps({"rule": rule, "until": until,
+                     "ts": time.time(), "duration_secs": secs}),
+        replace=True, delete_on_exit=False,
+    )
+    print(f"silenced sentinel rule {rule!r} for {secs:g}s "
+          f"(until {time.strftime('%H:%M:%S', time.localtime(until))}); "
+          f"fires are suppressed and counted as "
+          f"areal_sentinel_silenced_total")
+
+
 def profile_trigger(experiment: str, trial: str, out_dir: str,
                     secs: float = 5.0) -> None:
     from areal_tpu.base import telemetry
@@ -735,7 +863,8 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "flight-dump", "packfill", "blocksweep",
                                    "profile-trigger", "profile-status",
                                    "fleet-status", "drain", "cordon",
-                                   "uncordon", "reward-bench"):
+                                   "uncordon", "reward-bench", "alerts",
+                                   "silence"):
         return False
     cmd = argv[0]
     try:
@@ -778,6 +907,13 @@ def _dispatch_fleet_commands(argv) -> bool:
                 int(argv[2]) if len(argv) > 2 else 1792,
                 argv[3] if len(argv) > 3 else None,
             )
+        elif cmd == "alerts":
+            alerts(argv[1],
+                   argv[2] if len(argv) > 2 else "",
+                   argv[3] if len(argv) > 3 else "",
+                   argv[4] if len(argv) > 4 else "")
+        elif cmd == "silence":
+            silence(argv[1], argv[2], argv[3], argv[4])
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
                             float(argv[4]) if len(argv) > 4 else 5.0)
