@@ -35,6 +35,7 @@ from areal_tpu.api.train_config import (  # noqa: F401
     AutoscaleConfig,
     ExperimentSaveEvalControl,
     FaultToleranceConfig,
+    GoodputConfig,
     OptimizerConfig,
     RewardServiceConfig,
     SentinelConfig,
@@ -213,6 +214,14 @@ class BaseExperimentConfig:
     # rollout trace spans, Prometheus /metrics, and profiler triggers.
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
+    )
+    # Goodput ledger (docs/observability.md §Goodput): off by default —
+    # `goodput.enabled=true` (with telemetry on) turns on per-worker
+    # compute/comm/data_wait/idle time-in-state counters, live
+    # achieved-TFLOP/s + MFU gauges on the trainer and generation
+    # servers, and fleet-goodput stitching on the merged scrape.
+    goodput: GoodputConfig = dataclasses.field(
+        default_factory=GoodputConfig
     )
     # Training-health sentinel (docs/observability.md §Alerting): off by
     # default — `sentinel.enabled=true` (with telemetry on) arms the
@@ -491,6 +500,27 @@ def validate_config(cfg) -> None:
             raise ConfigError(
                 f"serving.min_rollout_share={share} must be in [0, 1] "
                 f"(fraction of each batch reserved for rollout traffic)"
+            )
+    gp = getattr(cfg, "goodput", None)
+    if gp is not None and getattr(gp, "enabled", False):
+        tel = getattr(cfg, "telemetry", None)
+        if tel is None or not getattr(tel, "enabled", False):
+            raise ConfigError(
+                "goodput.enabled=true requires telemetry.enabled=true: "
+                "the ledger exports through the telemetry registry and "
+                "the fleet stitch lives in the master's aggregator — "
+                "without telemetry there is nowhere to export "
+                "(docs/observability.md §Goodput)"
+            )
+        if getattr(gp, "export_interval_secs", 1.0) <= 0:
+            raise ConfigError(
+                f"goodput.export_interval_secs="
+                f"{gp.export_interval_secs} must be > 0"
+            )
+        if getattr(gp, "peak_flops_override", 0.0) < 0:
+            raise ConfigError(
+                f"goodput.peak_flops_override={gp.peak_flops_override} "
+                f"must be >= 0 (0 = auto-detect from the device kind)"
             )
     sn = getattr(cfg, "sentinel", None)
     if sn is not None and getattr(sn, "enabled", False):

@@ -93,6 +93,37 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class GoodputConfig:
+    """Goodput ledger (system/goodput.py, docs/observability.md §Goodput).
+
+    Off by default: with ``enabled=False`` every instrumented worker gets
+    the shared null ledger — no per-transition clock reads, no counters,
+    no MFU math — so the hot paths carry zero new work and the Prometheus
+    scrape is bit-identical to a build without the ledger. Enabled
+    (requires ``telemetry.enabled``), each worker classifies its wall
+    clock into ``compute / comm / data_wait / idle`` monotonic counters
+    (``goodput_secs_total{state=...}`` on the scrape, so Prometheus
+    ``rate()`` yields live utilization fractions), the trainer and
+    generation servers export live achieved-TFLOP/s + MFU gauges against
+    the per-generation peak table (``base/monitor.py``), and the master's
+    TelemetryAggregator stitches fleet goodput (useful chip-seconds /
+    total chip-seconds, split trainer vs generation side) onto the merged
+    scrape and ``telemetry.jsonl``."""
+
+    enabled: bool = False
+    # Minimum interval between counter exports from a ledger into its
+    # telemetry registry (transitions between exports only accrue
+    # host-side floats).
+    export_interval_secs: float = 1.0
+    # Override the per-chip peak FLOP/s used for live MFU gauges; 0 =
+    # auto-detect from the device kind (monitor.device_peak_flops). On an
+    # unknown device kind the MFU gauges degrade to achieved-TFLOP/s-only
+    # with a one-time warning — set this to restore MFU (e.g. CPU tests,
+    # unlisted hardware).
+    peak_flops_override: float = 0.0
+
+
+@dataclasses.dataclass
 class SentinelConfig:
     """Training-health sentinel (system/sentinel.py,
     docs/observability.md §Alerting).

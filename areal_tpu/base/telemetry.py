@@ -355,6 +355,14 @@ class TelemetryRegistry:
         with self._lock:
             self._gauges[name] = float(v)
 
+    def remove_gauge(self, name: str) -> None:
+        """Withdraw a gauge from the exposition entirely. For derived
+        gauges whose SUBJECT can disappear (a fleet side with no live
+        workers): a frozen last value would lie on the scrape, and
+        publishing 0.0 instead would read as a real collapse."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def observe(self, name: str, v: float,
                 buckets: Optional[Sequence[float]] = None) -> None:
         with self._lock:
@@ -908,7 +916,7 @@ class TelemetryAggregator:
                  metric_writer=None, http_port: int = 0,
                  traces_path: Optional[str] = None,
                  stitch_grace_secs: float = 5.0,
-                 sentinel=None):
+                 sentinel=None, goodput=None):
         import zmq
 
         self.jsonl_path = jsonl_path
@@ -917,6 +925,13 @@ class TelemetryAggregator:
         # the ingest loop — it owns no thread of its own. None (the
         # default) leaves ingest and the merged scrape bit-identical.
         self.sentinel = sentinel
+        # Optional fleet-goodput stitcher (system/goodput.FleetGoodput):
+        # fed every ingested snapshot's ledger counters; its derived
+        # gauges join the merged scrape as the "fleet" pseudo-worker and
+        # land in telemetry.jsonl on a slow cadence. None (the default)
+        # leaves ingest and the scrape bit-identical.
+        self.goodput = goodput
+        self._last_fleet_rec = 0.0
         self._writer = metric_writer
         self._seq = 0
         self.state: Dict[str, Dict[str, Any]] = {}
@@ -989,6 +1004,40 @@ class TelemetryAggregator:
                 )
             except Exception as e:  # noqa: BLE001 — watcher never kills
                 logger.warning(f"sentinel feed failed: {e}")
+        if self.goodput is not None:
+            try:
+                fg = self.goodput.update(worker,
+                                         payload.get("counters", {}))
+                if fg:
+                    if self.sentinel is not None:
+                        # Fleet goodput is derived HERE, not flushed by
+                        # any worker — feed it to the sentinel under its
+                        # own source identity so goodput_collapse-style
+                        # rules see the series. UNLABELED keys only: the
+                        # sentinel folds {side=...} variants into the
+                        # same family, and averaging the overall with
+                        # the per-side splits would mis-weight the sides
+                        # (and step-change when a side appears/expires).
+                        self.sentinel.feed("fleet:0", {
+                            k: v for k, v in fg.items() if "{" not in k
+                        })
+                    now = time.monotonic()
+                    if self._jsonl_file is not None \
+                            and now - self._last_fleet_rec > 5.0:
+                        # Slow-cadence fleet record so telemetry.jsonl
+                        # carries the stitched number without doubling
+                        # the per-snapshot volume.
+                        self._last_fleet_rec = now
+                        # Same record shape as the per-worker snapshots
+                        # so jsonl consumers never special-case the
+                        # fleet row.
+                        self._jsonl_file.write(json.dumps({
+                            "worker": "fleet:0", "time": time.time(),
+                            "counters": {}, "gauges": fg, "spans": [],
+                            "dropped_spans": 0, "hists": {},
+                        }) + "\n")
+            except Exception as e:  # noqa: BLE001 — derived, never kills
+                logger.warning(f"fleet goodput update failed: {e}")
         if self._jsonl_file is not None:
             rec = {"worker": worker, **{
                 k: payload.get(k) for k in
@@ -1059,6 +1108,13 @@ class TelemetryAggregator:
             sn = self.sentinel.registry.snapshot(reset=False)
             if sn["counters"] or sn["gauges"]:
                 rows["sentinel:0"] = sn
+        goodput = getattr(self, "goodput", None)  # duck-typed in tests
+        if goodput is not None:
+            # areal_fleet_goodput{side=...} joins the merged exposition
+            # as the fleet pseudo-worker (system/goodput.FleetGoodput).
+            fg = goodput.registry.snapshot(reset=False)
+            if fg["gauges"]:
+                rows["fleet:0"] = fg
         for worker, st in sorted(rows.items()):
             kind, _, idx = worker.partition(":")
             labels = {"worker_kind": kind, "worker_index": idx}

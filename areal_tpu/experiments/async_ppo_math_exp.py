@@ -65,6 +65,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 weight_stream_pipeline_depth=self.weight_sync.pipeline_depth,
                 serving=self.serving,
                 telemetry=self._telemetry(),
+                goodput=self.goodput,
                 keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
             )
             for i in range(n_gen)
@@ -104,6 +105,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 # recover checkpoints (rollout_worker.ConsumedLog).
                 recover_dir=paths["recover"],
                 telemetry=self._telemetry(),
+                goodput=self.goodput,
                 # Sandbox reward fleet (docs/rewards.md): enabled, agent
                 # reward callbacks grade over HTTP on the reward workers
                 # below instead of in the rollout process.

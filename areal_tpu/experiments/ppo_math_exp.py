@@ -336,6 +336,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             realloc_dir=paths["realloc"],
             weight_sync=self.weight_sync,
             telemetry=self._telemetry(),
+            goodput=self.goodput,
             reward_service=self.reward_service,
         )
 
@@ -378,6 +379,8 @@ class PPOMATHConfig(BaseExperimentConfig):
             # Training-health sentinel rides in the master's aggregator;
             # its alerts.jsonl/evidence default next to telemetry.jsonl.
             sentinel=self.sentinel,
+            # Fleet-goodput stitching rides in the same aggregator.
+            goodput=self.goodput,
             recover_dir=paths["recover"],
             recover=self.recover_mode == "resume",
         )

@@ -42,10 +42,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from areal_tpu.api.model import GenerationHyperparameters
-from areal_tpu.api.train_config import ServingConfig, TelemetryConfig
+from areal_tpu.api.train_config import (
+    GoodputConfig,
+    ServingConfig,
+    TelemetryConfig,
+)
 from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer  # noqa: F401 (engine deps)
+from areal_tpu.system import goodput as goodput_mod
 from areal_tpu.system import serving as serving_mod
 
 logger = logging.getLogger("system.genserver")
@@ -92,6 +97,10 @@ class GenerationServerConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Goodput ledger (system/goodput.py): prefill/decode compute vs
+    # queue-empty idle vs weight-update comm counters + analytic decode
+    # FLOP/s and MFU gauges per batch. Off by default — null ledger.
+    goodput: GoodputConfig = dataclasses.field(default_factory=GoodputConfig)
     # Liveness lease on the server's gen_servers/ registration
     # (docs/fault_tolerance.md): a SIGKILLed server's ghost URL expires
     # from discovery instead of being probed forever. 0 falls back to
@@ -182,6 +191,23 @@ class GenerationServer:
                 idx, cfg=cfg.telemetry,
             ) if cfg.telemetry.enabled else telemetry.NULL
         )
+        # Goodput ledger + live decode MFU (system/goodput.py): idle is
+        # the base state (queue-empty waits), decode/prefill windows
+        # enter compute, weight updates enter comm. Null when disabled.
+        self.ledger = goodput_mod.make_ledger(cfg.goodput, self.telemetry)
+        self._mfu = None
+        self._n_chips = 1
+        if self.ledger.enabled:
+            self._n_chips = max(jax.device_count(), 1)
+            self._mfu = goodput_mod.MfuEmitter(
+                self.telemetry,
+                goodput_mod.resolve_peak_flops(
+                    cfg.goodput, str(jax.devices()[0])
+                ),
+                tflops_name="genserver/decode_tflops",
+                mfu_name="genserver/decode_mfu",
+                context=f"genserver {cfg.server_id}",
+            )
         # The serving engine owns queueing, batch formation, retained-KV
         # lifecycle, and the compile-shape set; this server's handlers and
         # decode loop delegate those decisions (docs/serving.md).
@@ -302,7 +328,21 @@ class GenerationServer:
                 jnp.asarray(plens), S,
             )
             prefill_secs = time.monotonic() - t_prefill
-            self._prefill_tokens += int(plens[:len(fresh)].sum())
+            n_prefill = int(plens[:len(fresh)].sum())
+            self._prefill_tokens += n_prefill
+            if self._mfu is not None and prefill_secs > 0 and n_prefill:
+                # Analytic prefill FLOP/s (forward-only, shared formula
+                # family with the trainer's gauges — base/monitor.py).
+                from areal_tpu.base import monitor
+
+                pf = monitor.model_flops_per_token(
+                    self.model_cfg, n_prefill / max(len(fresh), 1),
+                    backward=False,
+                ) * n_prefill
+                self.telemetry.set_gauge(
+                    "genserver/prefill_tflops",
+                    pf / prefill_secs / self._n_chips / 1e12,
+                )
             for i, p in enumerate(fresh):
                 row_states[id(p)] = genmod.slice_state(st, i)
                 if p.trace is not None:
@@ -503,6 +543,11 @@ class GenerationServer:
     async def _runner(self):
         cfg = self.cfg
         while True:
+            # Re-anchor the ledger at idle every iteration: this loop is
+            # the partition's single owner (weight updates accrue comm
+            # via add(), never transitions — a concurrent restore racing
+            # the decode's would wedge the partition in a stale state).
+            self.ledger.enter("idle")
             first: _Pending = await self._queue.get()
             batch = [first]
             await asyncio.sleep(cfg.batch_window_ms / 1000)
@@ -528,7 +573,8 @@ class GenerationServer:
                         "decode", server_id=self.cfg.server_id,
                     )
                 with self.telemetry.span("genserver/decode_chunk",
-                                         batch_size=len(batch)) as attrs:
+                                         batch_size=len(batch)) as attrs, \
+                        self.ledger.state("compute"):
                     results = await asyncio.to_thread(
                         self._decode_batch, batch
                     )
@@ -540,6 +586,20 @@ class GenerationServer:
                                    attrs["tokens"])
                 dt = time.monotonic() - t_formed
                 t_decode_wall = time.time() - dt
+                if self._mfu is not None and attrs["tokens"] and dt > 0:
+                    # Analytic decode FLOP/s + MFU per batch: each new
+                    # token runs one forward at roughly the row's current
+                    # context length (base/monitor.py formula family).
+                    from areal_tpu.base import monitor
+
+                    avg_ctx = sum(
+                        len(p.prompt) + p.tokens_done for p in batch
+                    ) / len(batch)
+                    self._mfu.emit(
+                        monitor.model_flops_per_token(
+                            self.model_cfg, avg_ctx, backward=False
+                        ) * attrs["tokens"] / dt / self._n_chips
+                    )
                 chunk_tokens = max(
                     (len(r["output_ids"]) for r in results), default=0
                 )
@@ -775,6 +835,15 @@ class GenerationServer:
                 {"ok": False, "version": self.version, "error": str(e)},
                 status=500,
             )
+        finally:
+            # Weight-update comm is ACCRUED in the overlap family, not a
+            # partition transition: the update overlaps in-flight decodes
+            # on this event loop — a concurrent enter/restore pair would
+            # wedge the partition (the runner owns idle<->compute
+            # exclusively), and folding it into the partition counters
+            # would make states sum past wall clock, deflating every
+            # derived utilization fraction.
+            self.ledger.add_overlap("comm", time.monotonic() - t0)
         # Atomic (params, version) swap: in-flight _decode_batch threads
         # captured the old pair and tag their tokens with the old version.
         self.params = new
@@ -804,6 +873,10 @@ class GenerationServer:
         # after an eviction (docs/fault_tolerance.md).
         from aiohttp import web
 
+        # The manager's periodic probe doubles as the ledger's heartbeat:
+        # a long queue-empty idle accrues onto the scrape without waiting
+        # for the next decode transition.
+        self.ledger.poll()
         return web.json_response({
             "ok": True,
             "version": self.version,
@@ -819,6 +892,7 @@ class GenerationServer:
         })
 
     def _metrics_dict(self) -> Dict[str, Any]:
+        self.ledger.poll()  # scrape-time freshness for the idle state
         dt = max(time.monotonic() - self._t_start, 1e-6)
         d = {
             "generated_tokens": self._tokens_out,
@@ -940,5 +1014,6 @@ class GenerationServer:
                     p.future.set_exception(RuntimeError("server aborted"))
         if getattr(self, "_hb", None) is not None:
             self._hb.close()
+        self.ledger.flush()
         self.telemetry.close()
         await self._runner_obj.cleanup()

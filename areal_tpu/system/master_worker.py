@@ -42,6 +42,7 @@ logger = logging.getLogger("system.master")
 # because this module historically defined it.
 from areal_tpu.api.train_config import (  # noqa: E402,F401
     ExperimentSaveEvalControl,
+    GoodputConfig,
     SentinelConfig,
     TelemetryConfig,
 )
@@ -73,6 +74,12 @@ class MasterWorkerConfig:
     # constructed and the merged scrape is bit-identical.
     sentinel: SentinelConfig = dataclasses.field(
         default_factory=SentinelConfig
+    )
+    # Goodput ledger (system/goodput.py): when enabled the aggregator
+    # hosts the fleet-goodput stitcher (useful chip-seconds / total,
+    # split trainer vs generation) on the merged scrape. Off by default.
+    goodput: GoodputConfig = dataclasses.field(
+        default_factory=GoodputConfig
     )
     # recover checkpoints (RecoverInfo + trainer train-state) live here
     recover_dir: str = ""
@@ -157,6 +164,14 @@ class MasterWorker:
                     evidence_dir=(self.cfg.sentinel.evidence_dir
                                   or os.path.join(log_dir, "evidence")),
                 )
+            goodput_stitcher = None
+            if self.cfg.goodput.enabled:
+                # Fleet goodput (docs/observability.md §Goodput): derived
+                # from the worker ledgers' counters as they ingest; the
+                # merged scrape gains the "fleet" pseudo-worker row.
+                from areal_tpu.system.goodput import FleetGoodput
+
+                goodput_stitcher = FleetGoodput()
             self._aggregator = telemetry.TelemetryAggregator(
                 self.cfg.experiment, self.cfg.trial, jsonl_path=jsonl,
                 http_port=self.cfg.telemetry.http_port,
@@ -165,6 +180,7 @@ class MasterWorker:
                 traces_path=self.cfg.telemetry.traces_path,
                 stitch_grace_secs=self.cfg.telemetry.stitch_grace_secs,
                 sentinel=self._sentinel,
+                goodput=goodput_stitcher,
             )
             telemetry.configure(
                 self.cfg.experiment, self.cfg.trial, "master", 0,

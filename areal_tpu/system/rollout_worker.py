@@ -21,8 +21,13 @@ import numpy as np
 import areal_tpu.agents  # noqa: F401 — registers built-in agents/envs
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import GenerationHyperparameters, make_agent
-from areal_tpu.api.train_config import RewardServiceConfig, TelemetryConfig
+from areal_tpu.api.train_config import (
+    GoodputConfig,
+    RewardServiceConfig,
+    TelemetryConfig,
+)
 from areal_tpu.base import logging, name_resolve, names, telemetry
+from areal_tpu.system import goodput as goodput_mod
 from areal_tpu.rewards import client as reward_client
 from areal_tpu.datasets.jsonl import RL_TASKS, load_jsonl, load_shuffle_split
 from areal_tpu.base.retry import (
@@ -82,6 +87,11 @@ class RolloutWorkerConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Goodput ledger (system/goodput.py): gate-wait / generation-wait /
+    # grading counters in ACCRUAL mode — N concurrent rollouts make a
+    # wall-clock partition meaningless, so this worker exports
+    # task-seconds (excluded from fleet chip goodput). Off by default.
+    goodput: GoodputConfig = dataclasses.field(default_factory=GoodputConfig)
     # Sandbox reward fleet (docs/rewards.md): enabled, agent reward
     # callbacks fan grading out to the reward workers instead of
     # executing verification in THIS process. Off = legacy local grading.
@@ -178,6 +188,8 @@ class RolloutWorker:
         self._done = 0
         self._pushed = 0
         self._abandoned = 0
+        # Goodput accounting (null until run_async arms it).
+        self._ledger = goodput_mod.NULL_LEDGER
 
     def _prompt_sample(self, rec, uid: str) -> SequenceSample:
         ids = self.cfg.tokenizer.encode(rec["prompt"])
@@ -320,11 +332,15 @@ class RolloutWorker:
                     break
                 qid, prompt_ids, gconfig = get_obs.result()
                 gconfig = gconfig or cfg.gconfig
+                t_gen = time.monotonic()
                 results = await client.generate_group(
                     list(map(int, prompt_ids)), gconfig,
                     gconfig.n if gconfig is not cfg.gconfig else cfg.group_size,
                     eos_token_id=cfg.eos_token_id,
                 )
+                # Goodput: generation-wait — time this rollout spent
+                # blocked on the fleet (comm from the driver's seat).
+                self._ledger.add("comm", time.monotonic() - t_gen)
                 trajs = [
                     trajectory_from_gen(
                         f"{qid}@t{turn}" if turn else qid, j,
@@ -336,7 +352,11 @@ class RolloutWorker:
                 ]
                 turn += 1
                 await act_q.put(trajs)
+            t_grade = time.monotonic()
             final = await task
+            # Goodput: grading/finalization — the agent's reward path
+            # (env.step fanout or local grading) after the last chunk.
+            self._ledger.add("compute", time.monotonic() - t_grade)
             for t in final:
                 pusher.push(t.as_json_compatible())
                 if "version_start" in t.data:
@@ -414,6 +434,12 @@ class RolloutWorker:
                 cfg.experiment, cfg.trial, "rollout", cfg.worker_index,
                 cfg.telemetry,
             )
+            # Accrual-only ledger (initial_state=None): concurrent
+            # rollouts export task-seconds per phase, not a wall
+            # partition (module docstring in system/goodput.py).
+            self._ledger = goodput_mod.make_ledger(
+                cfg.goodput, telemetry.get(), initial_state=None,
+            )
         ctrl = WorkerControl(
             cfg.experiment, cfg.trial, f"rollout{cfg.worker_index}"
         )
@@ -468,6 +494,9 @@ class RolloutWorker:
                         # manager blips) before the successful attempt.
                         telemetry.observe("rollout/alloc_wait_secs",
                                           t_attempt - t0)
+                        # Goodput: gate-wait is data_wait from the
+                        # trainer's perspective — prompts held back.
+                        self._ledger.add("data_wait", t_attempt - t0)
                         # Same window as a trace-stage span so stitched
                         # timelines show where the gate held this sample.
                         if tctx is not None:
@@ -495,6 +524,7 @@ class RolloutWorker:
                 telemetry.set_gauge("rollout/inflight", len(pending))
                 telemetry.set_gauge("rollout/done", self._done)
                 telemetry.set_gauge("rollout/failovers", client.n_failovers)
+                self._ledger.poll()
                 while len(pending) < cfg.max_concurrent:
                     rec = self.records[pos % len(self.records)]
                     # Epoch passes over a small dataset re-visit the same
@@ -521,6 +551,7 @@ class RolloutWorker:
                 await asyncio.gather(*pending, return_exceptions=True)
         ctrl.close()
         self.consumed.close()
+        self._ledger.flush()
         telemetry.shutdown()  # final flush to the aggregator
         logger.info(
             f"rollout worker done: {self._pushed} trajectories pushed "

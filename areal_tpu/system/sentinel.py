@@ -107,6 +107,13 @@ METRIC_CATALOG = frozenset({
     "train/loss_weight", "train/total_tokens",
     # train engine counters/gauges (backend/jax_train.py)
     "train/tokens", "train/optimizer_steps", "train/pack_fill",
+    # goodput ledger + live MFU (system/goodput.py): per-worker
+    # time-in-state counters, the trainer's achieved-FLOP/s gauges, the
+    # generation servers' analytic decode/prefill FLOP/s, and the
+    # aggregator-derived fleet goodput (fed as source "fleet:0").
+    "goodput/secs", "train/achieved_tflops", "train/mfu",
+    "genserver/decode_tflops", "genserver/decode_mfu",
+    "genserver/prefill_tflops", "fleet/goodput", "fleet/goodput_workers",
     # trainer worker
     "trainer/store_size", "trainer/pull_queue_depth",
     "trainer/weight_publish_secs", "trainer/weight_publishes",
@@ -235,6 +242,16 @@ DEFAULT_RULES: Tuple[Dict[str, Any], ...] = (
      "cooldown": 900, "severity": "warn",
      "description": "step wall time far off its rolling baseline "
                     "(throughput regression)"},
+    # Needs goodput.enabled (the fleet/goodput series only exists when
+    # the ledger runs); with goodput off the rule simply never has data,
+    # like every rule on a disabled subsystem's metrics.
+    {"id": "goodput_collapse", "metric": "fleet/goodput",
+     "kind": "baseline", "value": 8.0, "for": 60, "window": 1200,
+     "cooldown": 900, "severity": "warn", "agg": "mean",
+     "description": "fleet goodput (useful chip-seconds / total) fell "
+                    "far off its rolling baseline: chips went idle — "
+                    "check the per-state split (perf_probe goodput) for "
+                    "which side starved"},
 )
 
 

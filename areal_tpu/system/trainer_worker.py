@@ -39,11 +39,13 @@ from areal_tpu.api.model import (
     make_interface,
 )
 from areal_tpu.api.train_config import (
+    GoodputConfig,
     RewardServiceConfig,
     TelemetryConfig,
     WeightSyncConfig,
 )
 from areal_tpu.base import logging, name_resolve, names, telemetry
+from areal_tpu.system import goodput as goodput_mod
 from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
 
 logger = logging.getLogger("system.trainer")
@@ -97,6 +99,10 @@ class TrainerWorkerConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # Goodput ledger (system/goodput.py): compute/comm/data_wait/idle
+    # time-in-state counters + live train/achieved_tflops + train/mfu
+    # gauges. Off by default — the null ledger costs nothing.
+    goodput: GoodputConfig = dataclasses.field(default_factory=GoodputConfig)
     # Sandbox reward fleet (docs/rewards.md): enabled, trainer-side
     # reward interfaces (sync-mode rw_math_code / fused) grade over HTTP
     # instead of executing verification in the trainer process. Off =
@@ -137,6 +143,10 @@ class TrainerWorker:
         self._model_factory = model_factory or self._default_model_factory
         self._exiting = False
         self._weight_publishers: Dict[str, Any] = {}  # role -> publisher
+        # Goodput accounting (null until setup() arms it on rank 0).
+        self._ledger = goodput_mod.NULL_LEDGER
+        self._mfu = None
+        self._flops = None
 
     # ---------------- setup ----------------
 
@@ -230,6 +240,12 @@ class TrainerWorker:
         # absent/disabled, configure() installs the no-op sink and no
         # watcher is created — the serve loop pays nothing.
         self._profiler = None
+        # Goodput ledger + live MFU (system/goodput.py): rank 0 only,
+        # like the rest of the control plane. Disabled (the default):
+        # the null ledger and no FLOPs math on any handler.
+        self._ledger = goodput_mod.NULL_LEDGER
+        self._mfu = None
+        self._flops = None
         if cfg.telemetry.enabled and self._rank0:
             telemetry.configure(
                 cfg.experiment, cfg.trial, "trainer", cfg.dist_rank,
@@ -238,6 +254,23 @@ class TrainerWorker:
             self._profiler = telemetry.ProfilerTriggerWatcher(
                 cfg.experiment, cfg.trial
             )
+            if cfg.goodput.enabled:
+                import jax
+
+                from areal_tpu.base import monitor
+
+                self._ledger = goodput_mod.make_ledger(
+                    cfg.goodput, telemetry.get()
+                )
+                self._mfu = goodput_mod.MfuEmitter(
+                    telemetry.get(),
+                    goodput_mod.resolve_peak_flops(
+                        cfg.goodput, str(jax.devices()[0])
+                    ),
+                    tflops_name="train/achieved_tflops",
+                    mfu_name="train/mfu", context="trainer",
+                )
+                self._flops = monitor.FlopsCounter()
         logger.info(
             f"trainer up (rank {cfg.dist_rank}/{cfg.dist_world}): "
             f"models={list(self.models)} mfcs={list(self.interfaces)}"
@@ -301,7 +334,8 @@ class TrainerWorker:
 
     def _handle_fetch(self, p: Payload) -> Any:
         with telemetry.span("trainer/data_wait",
-                            stream=self.cfg.stream_dataset) as attrs:
+                            stream=self.cfg.stream_dataset) as attrs, \
+                self._ledger.state("data_wait"):
             batch = self._read_batch(int(p.data or self.cfg.batch_size))
             attrs["n_seqs"] = batch.bs if batch is not None else 0
         telemetry.set_gauge("trainer/pull_queue_depth",
@@ -351,7 +385,8 @@ class TrainerWorker:
         t_mfc_wall = time.time()
         t_mfc = time.monotonic()
         with telemetry.span("trainer/mfc", mfc=mfc_name, method=method,
-                            n_seqs=batch.bs):
+                            n_seqs=batch.bs), \
+                self._ledger.state("compute"):
             if trace_dir:
                 # Env-gated per-MFC profiler (reference REAL_DUMP_TRACE,
                 # model_worker.py:829 __maybe_profile_rpc): one jax.profiler
@@ -369,6 +404,8 @@ class TrainerWorker:
         if method == "train_step":
             result["stats"] = out
             self._export_train_stats(mfc_name, out)
+            self._emit_mfu(mc.model_name, batch,
+                           time.monotonic() - t_mfc)
             self._emit_terminal_spans(
                 req["ids"], model, t_mfc_wall, time.monotonic() - t_mfc
             )
@@ -417,6 +454,39 @@ class TrainerWorker:
             if k in self._TRAIN_DIST_KEYS:
                 telemetry.observe(f"train/{k}_dist{{mfc={mfc_name}}}",
                                   float(v))
+
+    def _emit_mfu(self, role: str, batch: SequenceSample,
+                  dur_secs: float) -> None:
+        """Live achieved-FLOP/s + MFU for one train MFC: packed token
+        counts fed through the SAME analytic formulas bench.py reports
+        against (base/monitor.py FlopsCounter — the llama formula family
+        with the engine's real remat factor), divided by the step's wall
+        clock and the chip count. ``train/mfu`` degrades to
+        achieved-TFLOP/s-only on unknown device kinds (MfuEmitter).
+        No-op with goodput disabled."""
+        if self._flops is None or self._mfu is None or dur_secs <= 0:
+            return
+        engine = self.models[role].module
+        cfg = getattr(engine, "cfg", None)
+        if cfg is None or not batch.seqlens:
+            return
+        import jax
+
+        # The MAIN token key, not an arbitrary one: seqlens also carries
+        # scalar keys (rewards: [[1]] per sample), and set-ordered
+        # iteration could pick one of those — understating the gauges by
+        # orders of magnitude, nondeterministically.
+        lens = [float(v) for v in batch.total_lens()]
+        n_tokens = sum(lens)
+        if n_tokens <= 0:
+            return
+        self._flops.add_train(
+            cfg, n_tokens, n_tokens / max(len(lens), 1),
+            remat=bool(getattr(engine, "remat", False)),
+        )
+        self._mfu.emit(
+            self._flops.pop() / dur_secs / max(jax.device_count(), 1)
+        )
 
     def _emit_terminal_spans(self, ids, model, t_start: float,
                              dur_secs: float) -> None:
@@ -526,7 +596,8 @@ class TrainerWorker:
         path = os.path.join(self.cfg.realloc_dir, role, str(version))
         t0 = time.monotonic()
         with telemetry.span("trainer/weight_publish", role=role,
-                            version=version, transport="disk"):
+                            version=version, transport="disk"), \
+                self._ledger.state("comm"):
             self._save_role(role, path, fmt="native")
         save_secs = time.monotonic() - t0
         telemetry.set_gauge("trainer/weight_publish_secs", save_secs)
@@ -577,7 +648,8 @@ class TrainerWorker:
         # gather runs in the publisher's background thread, overlapping the
         # wire leg of tensors already gathered (and the servers' uploads).
         with telemetry.span("trainer/weight_publish", role=role,
-                            version=version, transport="stream"):
+                            version=version, transport="stream"), \
+                self._ledger.state("comm"):
             pub.publish(sorted(flatten_pytree(params).items()), version)
         publish_secs = time.monotonic() - t0
         telemetry.set_gauge("trainer/weight_publish_secs", publish_secs)
@@ -813,6 +885,9 @@ class TrainerWorker:
                     # name-resolve poll; docs/observability.md).
                     self._profiler.poll()
                 self.serve_once(timeout_ms=100)
+                # Accrue the in-progress state (idle between requests)
+                # so the scrape moves even when no handler runs.
+                self._ledger.poll()
                 telemetry.set_gauge("trainer/store_size", len(self.store))
             ctrl.close()
         else:
@@ -824,4 +899,5 @@ class TrainerWorker:
             self._puller.close()
         for pub in self._weight_publishers.values():
             pub.close()
+        self._ledger.flush()
         telemetry.shutdown()  # final flush to the aggregator

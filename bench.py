@@ -223,16 +223,16 @@ def main():
         if publisher is not None:
             publisher.close()
 
-    # Roofline context: analytic train FLOPs (6·N·T, llama formula family —
-    # reference realhf/base/monitor.py:288) over the bf16 peak of one chip.
+    # Roofline context over the bf16 peak of one chip. The 6·N·T train
+    # FLOPs estimate and the per-generation peak table live in
+    # base/monitor.py — ONE accounting shared with the live trainer's
+    # train/achieved_tflops + train/mfu gauges (system/goodput.py), so
+    # the bench number and the live gauges can never drift apart.
+    from areal_tpu.base import monitor
+
     n_params = transformer.param_count(cfg)
-    flops = 6.0 * n_params * (steps * total)
-    kind = str(jax.devices()[0]).lower()
-    peaks = {  # bf16 peak FLOP/s per chip
-        "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
-        "v4": 275e12, "v6e": 918e12, "v6": 918e12,
-    }
-    peak = next((v for k, v in peaks.items() if k in kind), None)
+    flops = monitor.train_flops_6nt(n_params, steps * total)
+    peak = monitor.device_peak_flops(str(jax.devices()[0]))
     mfu = (flops / dt / n_chips / peak) if peak else 0.0
 
     out = {

@@ -381,6 +381,38 @@ def test_baseline_deviation(tmp_path):
     assert s.states()["grad_spike"]["state"] == "firing"
 
 
+def test_goodput_collapse_default_rule(tmp_path):
+    """The default-pack goodput_collapse rule (docs/observability.md
+    §Goodput) fed the aggregator-derived fleet/goodput series (source
+    "fleet:0", exactly how TelemetryAggregator._ingest feeds it): a
+    stable busy fleet stays quiet; chips going idle fires warn after the
+    rule's for: hold — and the rolling-median baseline survives the
+    anomaly's own points (it must not self-clear)."""
+    raw = next(r for r in sn.DEFAULT_RULES if r["id"] == "goodput_collapse")
+    rules = parse_rules([dict(raw)])
+    s, at, cap = make_sentinel(tmp_path, rules)
+    # 300s of healthy fleet goodput around 0.8 with mild jitter
+    for i in range(300):
+        at(float(i))
+        s.feed("fleet:0", {"fleet/goodput": 0.8 + 0.01 * (i % 3)},
+               now=float(i))
+        s.tick(float(i))
+    assert s.states()["goodput_collapse"]["state"] == "ok"
+    # collapse: the fleet goes near-idle and STAYS there through the
+    # 60s for: hold (the 1200s median baseline is still dominated by
+    # the healthy history, so the anomaly cannot poison it)
+    for i in range(300, 380):
+        at(float(i))
+        s.feed("fleet:0", {"fleet/goodput": 0.05}, now=float(i))
+        s.tick(float(i))
+    assert s.states()["goodput_collapse"]["state"] == "firing"
+    firing = [r for r in read_alerts(tmp_path)
+              if r["event"] == "firing"]
+    assert firing and firing[0]["rule"] == "goodput_collapse"
+    assert firing[0]["severity"] == "warn"
+    assert firing[0]["value"] == 0.05
+
+
 def test_agg_across_workers_and_label_values(tmp_path):
     rules = parse_rules([
         {"id": "worst", "metric": "rollout/staleness_current",

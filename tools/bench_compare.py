@@ -1,0 +1,226 @@
+"""Bench regression gate: diff BENCH_r*.json rounds field-by-field.
+
+jax-free (stdlib only) — runnable in CI and on any operator laptop.
+
+Usage:
+  python tools/bench_compare.py BENCH_r04.json BENCH_r05.json [more...]
+         [--tol field=frac ...] [--quiet]
+
+Accepts two or more bench records, oldest first. Each file is either the
+driver wrapper form (``{"parsed": {...}}`` — what the BENCH_r* files in
+this repo are) or a bare bench.py JSON line. Prints the trajectory table
+across every file, then gates the NEWEST round against its predecessor
+with per-field relative tolerances:
+
+  field                      direction  default tolerance
+  value (tokens/s/chip)      higher     5%
+  vs_baseline (MFU proxy)    higher     5%
+  pack_fill                  higher     2%
+  weight_sync_latency_s      lower      15%
+  weight_sync_io_s           lower      25%
+  weight_sync_transport_s    lower      25%
+  train_phases.*             lower      25%
+
+Exit status 0 when every comparable field is within tolerance, 1 on any
+regression — wire it after bench.py so a perf PR cannot land a silent
+step backward on the BENCH_r* trajectory (docs/benchmarks.md).
+
+Caveats the gate understands:
+ - a field missing from either round (method additions like
+   ``train_phases``, telemetry-off runs) is reported ``n/a`` and never
+   gates;
+ - when ``weight_sync_transport_method`` differs between the two gated
+   rounds, every ``weight_sync_*`` field is skipped — the numbers
+   measure different things across a method discontinuity
+   (docs/benchmarks.md "Reading the numbers across rounds").
+
+``--tol field=frac`` overrides a tolerance (e.g. ``--tol value=0.10``,
+``--tol train_phases.fwd_bwd_s=0.5``); ``--tol default=frac`` sets the
+fallback for fields without a specific entry.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# field -> (direction, default relative tolerance). "higher" means bigger
+# is better (a drop beyond tolerance regresses); "lower" the opposite.
+FIELDS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.05),
+    "vs_baseline": ("higher", 0.05),
+    "pack_fill": ("higher", 0.02),
+    "weight_sync_latency_s": ("lower", 0.15),
+    "weight_sync_io_s": ("lower", 0.25),
+    "weight_sync_transport_s": ("lower", 0.25),
+}
+TRAIN_PHASE_SPEC = ("lower", 0.25)
+METHOD_FIELD = "weight_sync_transport_method"
+
+
+def load_bench(path: str) -> Dict[str, object]:
+    """One bench record, flattened: wrapper files yield their ``parsed``
+    dict; ``train_phases`` sub-fields flatten to ``train_phases.<k>``."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    flat: Dict[str, object] = {}
+    for k, v in d.items():
+        if k == "train_phases" and isinstance(v, dict):
+            for pk, pv in v.items():
+                flat[f"train_phases.{pk}"] = pv
+        else:
+            flat[k] = v
+    return flat
+
+
+def field_spec(field: str,
+               tol_overrides: Dict[str, float]) -> Optional[Tuple[str, float]]:
+    """(direction, tolerance) for a field, or None for ungated fields
+    (unit, metric, method strings...)."""
+    if field.startswith("train_phases."):
+        direction, tol = TRAIN_PHASE_SPEC
+    elif field in FIELDS:
+        direction, tol = FIELDS[field]
+    else:
+        return None
+    tol = tol_overrides.get(field, tol_overrides.get("default", tol))
+    return direction, tol
+
+
+def compare(prev: Dict[str, object], cur: Dict[str, object],
+            tol_overrides: Optional[Dict[str, float]] = None
+            ) -> List[Dict[str, object]]:
+    """Gate ``cur`` against ``prev``; one row per gated field:
+    {field, prev, cur, change, tol, status} with status in
+    ok | regression | improved | n/a | skipped-method-change."""
+    tol_overrides = tol_overrides or {}
+    method_changed = (
+        prev.get(METHOD_FIELD) is not None
+        and cur.get(METHOD_FIELD) is not None
+        and prev.get(METHOD_FIELD) != cur.get(METHOD_FIELD)
+    )
+    rows: List[Dict[str, object]] = []
+    for field in sorted(set(prev) | set(cur)):
+        spec = field_spec(field, tol_overrides)
+        if spec is None:
+            continue
+        direction, tol = spec
+        pv, cv = prev.get(field), cur.get(field)
+        row: Dict[str, object] = {
+            "field": field, "prev": pv, "cur": cv, "tol": tol,
+            "direction": direction,
+        }
+        if not isinstance(pv, (int, float)) \
+                or not isinstance(cv, (int, float)):
+            row["status"] = "n/a"
+            rows.append(row)
+            continue
+        if method_changed and field.startswith("weight_sync"):
+            row["status"] = "skipped-method-change"
+            rows.append(row)
+            continue
+        base = abs(float(pv))
+        if base > 0:
+            change = (float(cv) - float(pv)) / base
+        elif cv == pv:
+            change = 0.0
+        else:
+            # A zero baseline has no relative scale: any move off 0 in
+            # the bad direction must still gate (a lower-better field
+            # going 0 -> 3s is a regression, not "0% change").
+            change = math.inf if float(cv) > float(pv) else -math.inf
+        row["change"] = change
+        bad = (-change if direction == "higher" else change) > tol
+        good = (change if direction == "higher" else -change) > 0
+        row["status"] = ("regression" if bad
+                         else "improved" if good else "ok")
+        rows.append(row)
+    return rows
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return "-" if v is None else str(v)
+
+
+def print_trajectory(paths: List[str],
+                     records: List[Dict[str, object]]) -> None:
+    fields = sorted(
+        {f for r in records for f in r
+         if field_spec(f, {}) is not None}
+    )
+    name_w = max(len(f) for f in fields) if fields else 8
+    col_w = max(max((len(p) for p in paths), default=10), 10)
+    print("trajectory:")
+    print("  " + " " * name_w + "  "
+          + "  ".join(f"{p[-col_w:]:>{col_w}}" for p in paths))
+    for f in fields:
+        vals = "  ".join(f"{_fmt(r.get(f)):>{col_w}}" for r in records)
+        print(f"  {f:<{name_w}}  {vals}")
+
+
+def main(argv: List[str]) -> int:
+    paths: List[str] = []
+    tol_overrides: Dict[str, float] = {}
+    quiet = False
+    it = iter(argv)
+    for a in it:
+        if a == "--tol":
+            try:
+                k, _, v = next(it).partition("=")
+                tol_overrides[k] = float(v)
+            except (StopIteration, ValueError):
+                print("bench_compare: --tol expects field=frac",
+                      file=sys.stderr)
+                return 2
+        elif a == "--quiet":
+            quiet = True
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            paths.append(a)
+    if len(paths) < 2:
+        print("bench_compare: need at least two BENCH_r*.json files "
+              "(oldest first)\n", file=sys.stderr)
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        records = [load_bench(p) for p in paths]
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: cannot read bench record: {e}",
+              file=sys.stderr)
+        return 2
+    if not quiet:
+        print_trajectory(paths, records)
+    prev, cur = records[-2], records[-1]
+    rows = compare(prev, cur, tol_overrides)
+    regressions = [r for r in rows if r["status"] == "regression"]
+    if not quiet:
+        print(f"\ngate: {paths[-1]} vs {paths[-2]}")
+        for r in rows:
+            ch = r.get("change")
+            ch_s = f"{ch:+.1%}" if isinstance(ch, float) else "  -  "
+            mark = {"regression": "REGRESSION", "improved": "improved",
+                    "ok": "ok"}.get(str(r["status"]), str(r["status"]))
+            print(f"  {r['field']:<26} {_fmt(r['prev']):>10} -> "
+                  f"{_fmt(r['cur']):>10}  {ch_s:>8}  "
+                  f"(tol {r['tol']:.0%}, {r['direction']} better)  {mark}")
+    if regressions:
+        names = ", ".join(str(r["field"]) for r in regressions)
+        print(f"\nbench_compare: REGRESSION in {len(regressions)} "
+              f"field(s): {names}", file=sys.stderr)
+        return 1
+    print("\nbench_compare: no regression "
+          f"({sum(1 for r in rows if r['status'] in ('ok', 'improved'))} "
+          f"fields gated)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -85,6 +85,18 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       from the merged Prometheus scrape
                                       (docs/rewards.md); also accepts one
                                       worker url: reward-bench <url> [n]
+  goodput <exp> <trial> [window_s]    live goodput view of a run: per-
+                                      worker compute/comm/data_wait/idle
+                                      time-in-state fractions over a
+                                      short live window (two scrapes of
+                                      areal_goodput_secs_total diffed;
+                                      default 5s — a since-start split
+                                      would dilute a live stall by the
+                                      run's whole history), plus the
+                                      stitched fleet-goodput gauges and
+                                      live MFU (docs/observability.md
+                                      §Goodput); also accepts one
+                                      worker url: goodput <url>
   alerts <exp> <trial> [severity] [rule]
                                       training-health sentinel view of a
                                       LIVE run: alert totals + active
@@ -703,6 +715,121 @@ def silence(experiment: str, trial: str, rule: str, duration: str) -> None:
           f"areal_sentinel_silenced_total")
 
 
+def goodput_view(exp_or_url: str, trial: str = "",
+                 window_secs: float = 5.0) -> None:
+    """Live goodput ledger view (jax-free): per-worker time-in-state
+    fractions over a SHORT LIVE WINDOW — two scrapes of
+    ``areal_goodput_secs_total`` ``window_secs`` apart, diffed — plus
+    the fleet-goodput and live MFU gauges, off the merged scrape (or
+    one worker's /metrics when given a url). Windowed on purpose: a
+    since-start cumulative split dilutes a live stall by the whole
+    run's history (the same reason areal_fleet_goodput is windowed —
+    docs/observability.md §Goodput); workers whose counters did not
+    move inside the window fall back to their cumulative split, marked
+    ``(cum)``."""
+    import re as _re
+    import urllib.error
+    import urllib.request
+
+    if exp_or_url.startswith("http"):
+        url = exp_or_url.rstrip("/")
+    else:
+        from areal_tpu.base import name_resolve, names
+
+        try:
+            url = name_resolve.get(names.telemetry_http(exp_or_url, trial))
+        except Exception:  # noqa: BLE001 — telemetry off / no http port
+            sys.exit(
+                f"goodput: no merged telemetry endpoint for "
+                f"{exp_or_url}/{trial}.\nEither the run is down or "
+                f"telemetry has no http_port — relaunch with "
+                f"telemetry.enabled=true goodput.enabled=true "
+                f"telemetry.http_port=<port>, or probe one worker: "
+                f"goodput <url>."
+            )
+    if "/metrics" not in url:
+        url = url.rstrip("/") + "/metrics"
+    lab_re = _re.compile(r'(\w+)="([^"]*)"')
+
+    def fetch():
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = r.read().decode()
+        except (urllib.error.URLError, OSError) as e:
+            sys.exit(f"goodput: cannot reach {url}: {e}")
+        per_worker: dict = {}
+        overlap: dict = {}
+        extras = []
+        for ln in body.splitlines():
+            counters = ln.startswith("areal_goodput_secs_total{")
+            is_overlap = ln.startswith("areal_goodput_overlap_secs_total{")
+            if counters or is_overlap:
+                name, _, val = ln.rpartition(" ")
+                labels = dict(lab_re.findall(name))
+                worker = (
+                    f"{labels.get('worker_kind', labels.get('server_id', '?'))}"
+                    f":{labels.get('worker_index', '')}"
+                ).rstrip(":")
+                state = labels.get("state", "?")
+                tgt = overlap if is_overlap else per_worker
+                tgt.setdefault(worker, {})[state] = \
+                    tgt.get(worker, {}).get(state, 0.0) + float(val)
+            elif (ln.startswith("areal_fleet_goodput")
+                  or ln.startswith("areal_train_mfu")
+                  or ln.startswith("areal_train_achieved_tflops")
+                  or ln.startswith("areal_genserver_decode_mfu")
+                  or ln.startswith("areal_genserver_decode_tflops")
+                  or ln.startswith("areal_genserver_prefill_tflops")):
+                extras.append(ln)
+        return per_worker, overlap, extras
+
+    first, _, _ = fetch()
+    if not first:
+        print("no goodput counters on the scrape "
+              "(goodput.enabled=false, or no ledger export yet)")
+        return
+    time.sleep(max(window_secs, 0.1))
+    cum, overlap, extras = fetch()
+    if not cum:
+        # The aggregator restarted inside the sampling window and the
+        # fresh one has no state yet — same friendly exit as fetch one.
+        print("no goodput counters on the second scrape "
+              "(aggregator restarted mid-window? retry)")
+        return
+    states = ("compute", "comm", "data_wait", "idle")
+    w = max(len(k) for k in cum)
+    print(f"  last {window_secs:g}s window "
+          f"((cum) = counters idle in the window, since-start split):")
+    print(f"  {'worker':<{w}}  {'total_s':>9}  "
+          + "  ".join(f"{s:>9}" for s in states))
+    for worker, totals in sorted(cum.items()):
+        base = first.get(worker, {})
+        delta = {s: max(v - base.get(s, 0.0), 0.0)
+                 for s, v in totals.items()}
+        row, mark = (delta, "") if sum(delta.values()) > 0 \
+            else (totals, " (cum)")
+        total = sum(row.values())
+        fracs = "  ".join(
+            f"{row.get(s, 0.0) / total:>8.1%}" if total > 0
+            else f"{'-':>9}" for s in states
+        )
+        print(f"  {worker:<{w}}  {sum(totals.values()):>9.1f}  "
+              f"{fracs}{mark}")
+    print("  (rollout rows are task-seconds under concurrency, not a "
+          "wall partition — docs/observability.md §Goodput)")
+    if overlap:
+        print("overlap (work racing the owner's partition, e.g. weight "
+              "updates during decode — not in the fractions above):")
+        for worker, totals in sorted(overlap.items()):
+            split = "  ".join(f"{s}={v:.1f}s"
+                              for s, v in sorted(totals.items()))
+            print(f"  {worker:<{w}}  {split}")
+    if extras:
+        print("gauges:")
+        for ln in sorted(extras):
+            print(f"  {ln}")
+
+
 def profile_trigger(experiment: str, trial: str, out_dir: str,
                     secs: float = 5.0) -> None:
     from areal_tpu.base import telemetry
@@ -864,7 +991,7 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "profile-trigger", "profile-status",
                                    "fleet-status", "drain", "cordon",
                                    "uncordon", "reward-bench", "alerts",
-                                   "silence"):
+                                   "silence", "goodput"):
         return False
     cmd = argv[0]
     try:
@@ -914,6 +1041,13 @@ def _dispatch_fleet_commands(argv) -> bool:
                    argv[4] if len(argv) > 4 else "")
         elif cmd == "silence":
             silence(argv[1], argv[2], argv[3], argv[4])
+        elif cmd == "goodput":
+            if argv[1].startswith("http"):
+                goodput_view(argv[1], window_secs=(
+                    float(argv[2]) if len(argv) > 2 else 5.0))
+            else:
+                goodput_view(argv[1], argv[2], window_secs=(
+                    float(argv[3]) if len(argv) > 3 else 5.0))
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
                             float(argv[4]) if len(argv) > 4 else 5.0)
