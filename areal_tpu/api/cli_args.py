@@ -415,6 +415,14 @@ def apply_overrides(cfg, overrides: List[str]):
 # LocalLauncher — see docs/operations.md §Launching.
 VALID_MODES = ("local", "slurm")
 
+# MFC names the PPO experiment graph can schedule (ppo_math_exp.py);
+# per-MFC allocation entries must name one of these.
+KNOWN_MFCS = (
+    "actor_train", "actor_gen", "actor_inf",
+    "critic_train", "critic_inf",
+    "ref_inf", "rew_inf", "fused_rew_ref_inf",
+)
+
 
 def validate_config(cfg) -> None:
     """Config-parse-time sanity checks, called right after overrides/YAML
@@ -434,6 +442,42 @@ def validate_config(cfg) -> None:
             f"mode={mode!r} is not supported: valid modes are "
             f"{', '.join(VALID_MODES)} (docs/operations.md §Launching)"
         )
+    alloc_str = getattr(cfg, "allocation_mode", "") or ""
+    if alloc_str:
+        # Lazy import: parallel.mesh pulls in jax, which jax-free tool
+        # entrypoints must not pay for unless an allocation is configured.
+        from areal_tpu.parallel.mesh import AllocationMode
+
+        try:
+            alloc = AllocationMode.parse(alloc_str)
+        except ValueError as e:
+            raise ConfigError(
+                f"invalid allocation_mode {alloc_str!r}: {e}"
+            ) from None
+        n_devices = (
+            getattr(cfg, "n_nodes", 1) * getattr(cfg, "n_gpus_per_node", 8)
+        )
+        for mfc, spec in sorted(alloc.per_mfc.items()):
+            if mfc not in KNOWN_MFCS:
+                raise ConfigError(
+                    f"allocation_mode names unknown MFC '{mfc}': known "
+                    f"MFCs are {', '.join(KNOWN_MFCS)} "
+                    f"(experiments/ppo_math_exp.py builds the graph)"
+                )
+            if spec.world_size > n_devices:
+                raise ConfigError(
+                    f"allocation_mode MFC '{mfc}': spec '{spec}' needs "
+                    f"{spec.world_size} devices but the experiment has "
+                    f"n_nodes×n_gpus_per_node = {n_devices}"
+                )
+        for label, spec in (("global", alloc.global_spec),
+                            ("generation", alloc.gen_spec)):
+            if spec is not None and spec.world_size > n_devices:
+                raise ConfigError(
+                    f"allocation_mode {label} spec '{spec}' needs "
+                    f"{spec.world_size} devices but the experiment has "
+                    f"n_nodes×n_gpus_per_node = {n_devices}"
+                )
     nr = getattr(getattr(cfg, "cluster", None), "name_resolve", None)
     if nr is not None and getattr(nr, "type", "nfs") == "etcd3":
         # Same contract as the mode=ray rejection above: the descoped

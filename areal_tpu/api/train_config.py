@@ -46,14 +46,27 @@ class WeightSyncConfig:
     ``stream`` publishes per-tensor chunks over ZMQ straight from the
     trainer's host cache (system/weight_stream.py) — no checkpoint
     round-trip through the filesystem; ``disk`` is the legacy fallback
-    (native-pytree checkpoint under the realloc dir)."""
+    (native-pytree checkpoint under the realloc dir); ``device`` keeps
+    the weights on device end to end — the trainer reshards its live
+    params into the generation fleet's layout (parallel/reshard.py) and
+    servers swap them in with zero host hops. ``device`` requires the
+    trainer and generation fleet to share one JAX runtime."""
 
-    transport: str = "stream"  # stream | disk
+    transport: str = "stream"  # stream | disk | device
     # Wire chunk size (MB) for the streamed transport; smaller chunks
     # pipeline finer, larger chunks amortize framing.
     chunk_mb: int = 32
     # In-flight chunk requests per consuming server.
     pipeline_depth: int = 4
+    # Device transport: transfer-group byte budget (MB) for the mesh→mesh
+    # reshard — peak extra HBM during a publish is ~one group of
+    # target-layout leaves (docs/weight_sync.md §HBM headroom).
+    transfer_group_mb: int = 64
+    # Device transport: the generation fleet's ParallelSpec (e.g. "d4t2").
+    # None publishes in the ungridded single-device layout — correct for
+    # un-meshed generation servers; decoupled experiments thread
+    # AllocationMode.gen_spec through here automatically.
+    gen_parallel_spec: Optional[str] = None
 
 
 @dataclasses.dataclass

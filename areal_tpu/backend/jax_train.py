@@ -238,6 +238,13 @@ class JaxTrainEngine(TrainableEngine):
         self._grad_fns: Dict[int, Callable] = {}
         self._fwd_fns: Dict[int, Callable] = {}
         self._apply_fn = None
+        # Static gate for MoE router input jitter: train steps thread a
+        # per-micro-batch rng key through the batch dict iff this is set
+        # (key presence is part of the jit trace, so the gate must not
+        # flip per step — it is fixed by the model config).
+        self._router_jitter = (
+            cfg.moe is not None and cfg.moe.input_jitter_eps > 0
+        )
 
     # -------------- internals --------------
 
@@ -269,6 +276,7 @@ class JaxTrainEngine(TrainableEngine):
             remat=self.remat,
             return_kv=False,
             return_aux=True,
+            rng=batch.get("rng"),
         )
         # Critic values [B, L] are cheap in f32; lm logits [B, L, V] stay in
         # the compute dtype — loss fns upcast per-element inside fused
@@ -295,6 +303,7 @@ class JaxTrainEngine(TrainableEngine):
             segment_ids=batch["segment_ids"],
             attn_impl=self.attn_impl, remat=self.remat,
             return_kv=False, return_aux=True, return_hidden=True,
+            rng=batch.get("rng"),
         )
         R, L, D = h.shape
         labels = F.next_token_labels(batch["tokens"])
@@ -589,6 +598,19 @@ class JaxTrainEngine(TrainableEngine):
         scale = 1.0 if glob else 1.0 / len(idxs)
         aux_scale = (1.0 / len(idxs)) if glob else 1.0
         carry = None
+        seq = ub.seq
+        if self._router_jitter:
+            # Stacked per-mb jitter keys ride the seq dict: the sliced grad
+            # fn's dynamic_index_in_dim over axis 0 hands each micro-batch
+            # its own [2] key (same derivation as train_batch: one base key
+            # per optimizer step). ub.seq itself stays untouched so the
+            # run_prep jit (keyed on the seq structure) never retraces.
+            seq = dict(
+                ub.seq,
+                rng=jax.random.split(
+                    jax.random.PRNGKey(self.opt_step_count), ub.n_mbs
+                ),
+            )
         with telemetry.span("train/fwd_bwd", n_mbs=len(idxs)):
             for i, w in zip(idxs, weights):
                 denom = total_w if glob else w
@@ -596,7 +618,7 @@ class JaxTrainEngine(TrainableEngine):
                     loss_fn, with_carry=carry is not None, R=ub.R
                 )
                 args = [
-                    self.params, ub.grids, ub.seq, jnp.asarray(i, jnp.int32),
+                    self.params, ub.grids, seq, jnp.asarray(i, jnp.int32),
                     jnp.asarray(denom, jnp.float32),
                     jnp.asarray(scale, jnp.float32),
                     jnp.asarray(aux_scale, jnp.float32),
@@ -692,10 +714,20 @@ class JaxTrainEngine(TrainableEngine):
         scale = 1.0 if glob else 1.0 / n_mbs
         aux_scale = (1.0 / n_mbs) if glob else 1.0
         carry = None
+        # Router jitter: one deterministic base key per optimizer step,
+        # folded with the micro-batch index so every mb perturbs the router
+        # input independently (moe_mlp). batch["rng"] is only present when
+        # the model config enables jitter — key presence is trace-static.
+        jitter_key = (
+            jax.random.PRNGKey(self.opt_step_count)
+            if self._router_jitter else None
+        )
         with telemetry.span("train/fwd_bwd", n_mbs=n_mbs):
-            for mb, w in zip(mbs, weights):
+            for i, (mb, w) in enumerate(zip(mbs, weights)):
                 denom = total_w if glob else w
                 batch = self._device_batch(mb)
+                if jitter_key is not None:
+                    batch["rng"] = jax.random.fold_in(jitter_key, i)
                 grad_fn = self._get_grad_fn(loss_fn,
                                             with_carry=carry is not None)
                 args = [

@@ -79,6 +79,15 @@ def weight_stream(experiment: str, trial: str, role: str) -> str:
     return f"{_base(experiment, trial)}/weight_stream/{role}"
 
 
+def weight_device(experiment: str, trial: str, role: str) -> str:
+    """On-device publication descriptor for ``role`` — present iff the
+    trainer publishes over the ``device`` transport (parallel/reshard.py
+    registry). Value: JSON {pid, version, digest}; the digest is the
+    out-of-band integrity gate the generation server verifies before the
+    swap. Absence → stream/disk auto-detection as before."""
+    return f"{_base(experiment, trial)}/weight_device/{role}"
+
+
 def experiment_status(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/exp_status"
 

@@ -28,6 +28,34 @@ def resolve_allocation(cfg: BaseExperimentConfig) -> AllocationMode:
     return AllocationMode.parse(cfg.allocation_mode)
 
 
+# Which MFCs a model role serves, train-MFC first: a per-MFC allocation
+# override for any of these steers the whole role's engine (one engine per
+# role; the train layout wins when both a train and an inf MFC are named).
+ROLE_MFCS: Dict[str, tuple] = {
+    "actor": ("actor_train", "actor_inf", "actor_gen"),
+    "critic": ("critic_train", "critic_inf"),
+    "ref": ("ref_inf", "fused_rew_ref_inf"),
+    "rew": ("rew_inf", "fused_rew_ref_inf"),
+}
+
+
+def spec_for_role(alloc: AllocationMode, role: str) -> Optional[ParallelSpec]:
+    """The ParallelSpec a model role's engine runs under.
+
+    Heterogeneous per-MFC allocations (``AllocationMode.per_mfc``, e.g.
+    ``actor_train:f2t2,ref_inf:d2``) place each named MFC on its own
+    sub-mesh; roles without an override inherit ``global_spec``. Data
+    crossing between differently-sharded roles (param realloc, device
+    weight sync) is moved on device by parallel/reshard.py at the MFC
+    boundary.
+    """
+    for mfc in ROLE_MFCS.get(role, ()):
+        spec = alloc.per_mfc.get(mfc)
+        if spec is not None:
+            return spec
+    return alloc.global_spec
+
+
 def model_init_dict(mc: ModelTrainEvalConfig) -> Dict[str, Any]:
     """ModelTrainEvalConfig → TrainerWorker ModelRoleConfig.init dict."""
     if mc.tiny:

@@ -237,7 +237,13 @@ class PPOMATHConfig(BaseExperimentConfig):
         )
 
         alloc = C.resolve_allocation(self)
-        spec = alloc.global_spec
+        # Heterogeneous per-MFC allocations (e.g. actor_train:f2t2,ref_inf:d2)
+        # give each role the spec of its own MFC — the role's engine then
+        # builds a sub-mesh over devices[:world_size] and parallel/reshard.py
+        # moves tensors across the MFC boundary on device.
+        actor_spec = C.spec_for_role(alloc, "actor")
+        ref_spec = C.spec_for_role(alloc, "ref")
+        critic_spec = C.spec_for_role(alloc, "critic")
         paths = C.experiment_paths(self)
         dataset_size = self._dataset_size()
         steps_per_epoch = max(
@@ -249,13 +255,15 @@ class PPOMATHConfig(BaseExperimentConfig):
         models: Dict[str, ModelRoleConfig] = {
             "actor": ModelRoleConfig(
                 init=C.model_init_dict(self.actor),
-                backend_args=C.backend_args_for(self.actor, spec, total_steps),
+                backend_args=C.backend_args_for(self.actor, actor_spec,
+                                                total_steps),
             ),
         }
         if self._use_ref:
             models["ref"] = ModelRoleConfig(
                 init=C.model_init_dict(self.ref),
-                backend_args=C.backend_args_for(self.ref, spec, total_steps),
+                backend_args=C.backend_args_for(self.ref, ref_spec,
+                                                total_steps),
                 train=False,
             )
         if self._use_critic:
@@ -264,7 +272,8 @@ class PPOMATHConfig(BaseExperimentConfig):
                 critic = self.actor  # default: init critic from actor shape
             models["critic"] = ModelRoleConfig(
                 init=C.model_init_dict(critic),
-                backend_args=C.backend_args_for(critic, spec, total_steps),
+                backend_args=C.backend_args_for(critic, critic_spec,
+                                                total_steps),
             )
         fuse = self.fuse_rew_ref and self._use_ref and not async_mode
         mfcs: Dict[str, MFCRuntimeConfig] = {}
@@ -316,6 +325,16 @@ class PPOMATHConfig(BaseExperimentConfig):
             interface="ppo_actor", interface_args={"hp": hp},
             model_name="actor",
         )
+        weight_sync = self.weight_sync
+        if (weight_sync.transport == "device"
+                and not weight_sync.gen_parallel_spec
+                and alloc.gen_spec is not None):
+            # Decoupled allocation: the device publish reshards straight into
+            # the generation fleet's layout so the consumer-side swap is a
+            # zero-copy lookup.
+            weight_sync = dataclasses.replace(
+                weight_sync, gen_parallel_spec=str(alloc.gen_spec)
+            )
         return TrainerWorkerConfig(
             experiment=self.experiment_name, trial=self.trial_name,
             handler="trainer",
@@ -334,7 +353,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             tokenizer=None,  # resolved in-process by the launcher entry
             stream_dataset=async_mode,
             realloc_dir=paths["realloc"],
-            weight_sync=self.weight_sync,
+            weight_sync=weight_sync,
             telemetry=self._telemetry(),
             goodput=self.goodput,
             reward_service=self.reward_service,

@@ -61,13 +61,11 @@ def moe_mlp(
     n_valid = jnp.maximum(jnp.sum(valid), 1.0)
 
     router_in = xf
-    if moe.input_jitter_eps > 0:
-        if rng is None:
-            raise NotImplementedError(
-                "input_jitter_eps > 0 needs an rng key threaded into the "
-                "forward pass; jitter is not wired yet (reference "
-                "router.py:170) — set input_jitter_eps=0"
-            )
+    if moe.input_jitter_eps > 0 and rng is not None:
+        # Router input jitter (reference router.py:170): train steps thread
+        # a per-micro-batch key down through transformer.forward(rng=...);
+        # inference passes rng=None and routes on the clean input — jitter
+        # is a training-only regulariser, never a serving behaviour.
         eps = moe.input_jitter_eps
         router_in = xf * jax.random.uniform(
             rng, xf.shape, minval=1 - eps, maxval=1 + eps, dtype=xf.dtype

@@ -170,6 +170,7 @@ def _block(
     kv_valid: Optional[jnp.ndarray],
     attn_impl: str,
     allow_ring: bool = True,
+    rng: Optional[jnp.ndarray] = None,  # per-layer key for MoE router jitter
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], Optional[Dict[str, jnp.ndarray]]]:
     B, T, D = h.shape
     dh = cfg.head_dim
@@ -257,7 +258,7 @@ def _block(
         from areal_tpu.models import moe as moemod
 
         mlp, aux = moemod.moe_mlp(
-            x, lp, cfg.moe,
+            x, lp, cfg.moe, rng=rng,
             mask=(segment_ids > 0) if segment_ids is not None else None,
         )
     elif cfg.mlp_type == "plain":
@@ -280,6 +281,7 @@ def apply_layer_stack(
     attn_impl: str = "auto",
     remat=False,
     allow_ring: bool = True,
+    rng: Optional[jnp.ndarray] = None,
 ):
     """Run a stacked layer dict over ``h`` via lax.scan (packed mode, no KV
     out). Returns (h, aux) where aux stacks per-layer MoE scalars ({} for
@@ -288,7 +290,27 @@ def apply_layer_stack(
 
     ``remat``: False | True/"full" (recompute the whole layer in backward)
     | "dots" (save matmul outputs, recompute elementwise/norm/cast —
-    near-free recompute, releases the non-GEMM residuals)."""
+    near-free recompute, releases the non-GEMM residuals).
+
+    ``rng``: base key for MoE router input jitter — split per layer and
+    scanned alongside the params so each layer perturbs independently.
+    ``rng=None`` keeps the original scan body (bit-identical off path)."""
+
+    if rng is not None:
+        n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+        layer_keys = jax.random.split(rng, n_layers)
+
+        def body(h, xs):
+            lp, key = xs
+            h2, _, aux = _block(
+                cfg, h, lp, cos, sin, segment_ids, positions,
+                None, None, None, attn_impl, allow_ring=allow_ring, rng=key,
+            )
+            return h2, aux
+
+        body = _maybe_checkpoint(body, remat)
+        h, aux = jax.lax.scan(body, h, (layer_params, layer_keys))
+        return h, (aux if aux is not None else {})
 
     def body(h, lp):
         h2, _, aux = _block(
@@ -330,6 +352,7 @@ def forward(
     return_aux: bool = False,  # also return MoE aux losses (layer means)
     pp_microbatches: Optional[int] = None,  # pipeline depth (None = auto)
     return_hidden: bool = False,  # skip the head; return final hidden
+    rng: Optional[jnp.ndarray] = None,  # MoE router-jitter key (train only)
 ):
     """Returns (output, kv) — or (output, kv, aux) when ``return_aux`` —
     where output is logits [B, T, V] (or values [B, T] for critics) and kv
@@ -393,9 +416,13 @@ def forward(
             # remat note: HBM-for-FLOPs trade (the reference relies on
             # Megatron activation checkpointing; here one jax.checkpoint
             # over the scan body).
+            # Router jitter rides only this (training) path: decode and
+            # KV-returning forwards are inference, where jitter is off by
+            # construction; the pipeline path drops it rather than thread
+            # keys through collective permutes.
             h, aux = apply_layer_stack(
                 cfg, h, layer_params, cos, sin, segment_ids, positions,
-                attn_impl=attn_impl, remat=remat,
+                attn_impl=attn_impl, remat=remat, rng=rng,
             )
     # aux ys are stacked per-layer [n_layers] (already reduced in the
     # pipeline path). The optimized total SUMS over layers (the reference's

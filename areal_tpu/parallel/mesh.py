@@ -148,8 +148,17 @@ class AllocationMode:
         if ":" in s:  # per-MFC: "actor_gen:d4t2,actor_train:f4t4"
             per = {}
             for part in s.split(","):
-                name, spec = part.split(":")
-                per[name.strip()] = ParallelSpec.parse(spec)
+                name, sep, spec = part.partition(":")
+                if not sep or not name.strip() or not spec.strip():
+                    raise ValueError(
+                        f"malformed per-MFC allocation entry '{part}' in '{s}'"
+                    )
+                name = name.strip()
+                if name in per:
+                    raise ValueError(
+                        f"duplicate MFC '{name}' in allocation mode '{s}'"
+                    )
+                per[name] = ParallelSpec.parse(spec)
             train = per.get("actor_train") or next(iter(per.values()))
             gen = per.get("actor_gen")
             return cls(global_spec=train, gen_spec=gen, per_mfc=per)

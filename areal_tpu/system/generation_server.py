@@ -803,17 +803,45 @@ class GenerationServer:
         finally:
             consumer.close()
 
+    def _reshard_published_weights(self, role: str, version: int,
+                                   digest: str):
+        """Device transport (docs/weight_sync.md §device): the trainer
+        resharded its live params into this fleet's layout ON DEVICE and
+        registered them (parallel/reshard.py); the fanout payload carries
+        the publication digest out of band. consume_device verifies
+        version + digest + tree compatibility against the live pytree
+        before returning the weights resharded into this server's own
+        shardings — any gate failure raises with the old weights still
+        live, the same contract as a torn stream."""
+        import jax
+
+        from areal_tpu.parallel import reshard as rsh
+
+        new = rsh.consume_device(
+            self.cfg.experiment, self.cfg.trial, role,
+            version, digest, self.params,
+        )
+        jax.block_until_ready(new)
+        return new
+
     async def handle_update_weights(self, request):
         from aiohttp import web
 
         d = await request.json()
         t0 = time.monotonic()
-        transport = "stream" if d.get("endpoint") else "disk"
+        transport = ("device" if d.get("device")
+                     else "stream" if d.get("endpoint") else "disk")
         try:
             with self.telemetry.span("genserver/weight_update",
                                      transport=transport,
                                      version=int(d.get("version", -1))):
-                if d.get("endpoint"):
+                if d.get("device"):
+                    new = await asyncio.to_thread(
+                        self._reshard_published_weights,
+                        d.get("role", "actor"), int(d["version"]),
+                        d.get("digest", ""),
+                    )
+                elif d.get("endpoint"):
                     new = await asyncio.to_thread(
                         self._stream_and_put_weights, d["endpoint"],
                         int(d["version"]),

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import json
 import os
 import shutil
 import time
@@ -362,11 +363,28 @@ class GserverManager:
 
     def _update_payload(self, v: int, path: str) -> Dict:
         """The /update_weights request body for version ``v``. Transport is
-        auto-detected per push: a trainer publishing over the streamed
-        transport registers its WeightStreamPublisher endpoint under
-        names.weight_stream — servers then pull chunks from the trainer's
-        host cache; otherwise the legacy disk payload points at the
-        realloc checkpoint (docs/weight_sync.md)."""
+        auto-detected per push, most-direct first: a trainer publishing
+        over the DEVICE transport registers a publication descriptor under
+        names.weight_device — servers swap the on-device publication in
+        (parallel/reshard.py), with the descriptor's digest as the
+        integrity gate; a STREAM trainer registers its
+        WeightStreamPublisher endpoint under names.weight_stream — servers
+        pull chunks from the trainer's host cache; otherwise the legacy
+        disk payload points at the realloc checkpoint
+        (docs/weight_sync.md)."""
+        try:
+            desc = json.loads(name_resolve.get(names.weight_device(
+                self.cfg.experiment, self.cfg.trial, self.cfg.model_role
+            )))
+        except Exception:  # noqa: BLE001 — no device publication
+            desc = None
+        if desc and int(desc.get("version", -1)) == v:
+            # A version-skewed descriptor (descriptor written, version key
+            # not yet bumped — or vice versa after a crash) falls through
+            # to stream/disk rather than steering the fleet at a
+            # publication whose digest gate is guaranteed to fail.
+            return {"device": True, "role": self.cfg.model_role,
+                    "digest": desc.get("digest", ""), "version": v}
         try:
             endpoint = name_resolve.get(names.weight_stream(
                 self.cfg.experiment, self.cfg.trial, self.cfg.model_role

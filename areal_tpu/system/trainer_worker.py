@@ -529,13 +529,30 @@ class TrainerWorker:
             # EMA: target := eta*source + (1-eta)*target (reference ref-EMA)
             import jax
 
+            from areal_tpu.parallel import reshard as rsh
+
             src = self.models[hook["source"]].module
             dst = self.models[hook["target"]].module
             eta = float(hook.get("eta", 1.0))
+            # MFC-boundary reshard: under a heterogeneous per-MFC allocation
+            # the source and target roles live on different meshes, so move
+            # the source tree into the target's layout on device first — the
+            # EMA math then runs entirely on the target's mesh. Same-layout
+            # roles hit the zero-copy no-op path (plan.n_moved == 0).
+            src_params, plan = rsh.reshard_pytree(
+                src.params, rsh.shardings_of(dst.params)
+            )
+            if plan.n_moved:
+                with self._ledger.state("comm"):
+                    jax.block_until_ready(src_params)
+                logger.info(
+                    f"param_realloc reshard {hook['source']}→{hook['target']}: "
+                    + plan.describe()
+                )
             dst.params = jax.tree.map(
                 lambda s, d: (eta * s.astype(np.float32)
                               + (1 - eta) * d.astype(np.float32)).astype(d.dtype),
-                src.params, dst.params,
+                src_params, dst.params,
             )
         else:
             raise ValueError(f"unknown hook {hook}")
@@ -584,12 +601,18 @@ class TrainerWorker:
         Transport "stream" (docs/weight_sync.md) hands the tensors to a
         per-role WeightStreamPublisher: servers pull per-tensor chunks
         over ZMQ straight from this process's host cache — no checkpoint
-        round-trip through the filesystem. Transport "disk" is the legacy
-        fallback: NATIVE pytree format under the realloc dir (models/hf.py
-        save_native_checkpoint — skips HF layout conversion both ways;
-        persistent "save" hooks stay HF)."""
+        round-trip through the filesystem. Transport "device" never leaves
+        the accelerator: the live params reshard into the generation
+        fleet's layout on device (parallel/reshard.py) and servers swap
+        them straight out of the publish registry. Transport "disk" is the
+        legacy fallback: NATIVE pytree format under the realloc dir
+        (models/hf.py save_native_checkpoint — skips HF layout conversion
+        both ways; persistent "save" hooks stay HF)."""
         if self.cfg.weight_sync.transport == "stream":
             self._publish_weights_stream(role)
+            return
+        if self.cfg.weight_sync.transport == "device":
+            self._publish_weights_device(role)
             return
         model = self.models[role]
         version = model.version.global_step
@@ -604,16 +627,11 @@ class TrainerWorker:
         telemetry.inc("trainer/weight_publishes")
         if not self._rank0:
             return
-        # A crashed stream-mode predecessor may have left its endpoint in
-        # name_resolve; clear it so the manager's transport auto-detection
-        # routes this publish (and all later ones) at the disk checkpoint
-        # instead of a dead publisher socket.
-        try:
-            name_resolve.delete(names.weight_stream(
-                self.cfg.experiment, self.cfg.trial, role
-            ))
-        except Exception:  # noqa: BLE001 — normally absent
-            pass
+        # A crashed stream/device-mode predecessor may have left its
+        # discovery keys in name_resolve; clear them so the manager's
+        # transport auto-detection routes this publish (and all later
+        # ones) at the disk checkpoint instead of a dead publisher.
+        self._clear_stale_transport_keys(role, keep="disk")
         self._bump_version(role, version, save_secs)
         logger.info(
             f"published {role} weights v{version} -> {path} "
@@ -654,12 +672,88 @@ class TrainerWorker:
         publish_secs = time.monotonic() - t0
         telemetry.set_gauge("trainer/weight_publish_secs", publish_secs)
         telemetry.inc("trainer/weight_publishes")
+        self._clear_stale_transport_keys(role, keep="stream")
         self._bump_version(role, version, publish_secs)
         logger.info(
             f"published {role} weights v{version} -> {pub.endpoint} "
             f"(stream publish {publish_secs:.2f}s; gather continues in "
             f"background)"
         )
+
+    def _publish_weights_device(self, role: str) -> None:
+        """Transport "device" (docs/weight_sync.md): reshard the live
+        params into the generation fleet's layout ON DEVICE and register
+        the result in the in-process publish registry — no d2h, no wire,
+        no disk. The fanout payload carries the publication digest out of
+        band, so the generation server's swap stays manifest/digest-gated
+        exactly like the streamed path."""
+        from areal_tpu.parallel import reshard as rsh
+
+        model = self.models[role]
+        version = model.version.global_step
+        t0 = time.monotonic()
+        params = self._compute_dtype_params(role)
+        target = self._device_publish_shardings(role, params)
+        with telemetry.span("trainer/weight_publish", role=role,
+                            version=version, transport="device"), \
+                self._ledger.state("comm"):
+            pub = rsh.publish_device(
+                self.cfg.experiment, self.cfg.trial, role, params,
+                target_shardings=target, version=version,
+                group_mb=self.cfg.weight_sync.transfer_group_mb,
+            )
+        publish_secs = time.monotonic() - t0
+        telemetry.set_gauge("trainer/weight_publish_secs", publish_secs)
+        # First-class latency histogram: the device transport's whole
+        # point is taking this from minutes to sub-second — the
+        # distribution (not just the last value) is the acceptance metric.
+        telemetry.observe("trainer/weight_publish_latency_secs",
+                          publish_secs)
+        telemetry.inc("trainer/weight_publishes")
+        if not self._rank0:
+            return
+        self._clear_stale_transport_keys(role, keep="device")
+        self._bump_version(role, version, publish_secs)
+        logger.info(
+            f"published {role} weights v{version} on device "
+            f"({pub.plan.n_moved} leaves moved/"
+            f"{len(pub.plan.identical)} zero-copy, "
+            f"{publish_secs:.3f}s)"
+        )
+
+    def _device_publish_shardings(self, role: str, params):
+        """Target layout for a device publish: the gen fleet's spec when
+        configured (weight_sync.gen_parallel_spec — decoupled experiments
+        thread AllocationMode.gen_spec through), else the ungridded
+        single-device layout un-meshed generation servers hold."""
+        from areal_tpu.parallel import mesh as pmesh
+        from areal_tpu.parallel import reshard as rsh
+
+        gen_spec = self.cfg.weight_sync.gen_parallel_spec
+        engine = self.models[role].module
+        model_cfg = getattr(engine, "cfg", None)
+        if gen_spec and model_cfg is not None:
+            mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse(gen_spec))
+            return rsh.model_shardings(mesh, model_cfg)
+        return rsh.shardings_like(params, rsh.model_shardings(None, None))
+
+    def _clear_stale_transport_keys(self, role: str, keep: str) -> None:
+        """Drop the OTHER transports' discovery keys so the manager's
+        auto-detection can never steer a fanout at a transport this
+        trainer is not publishing on (e.g. a crashed predecessor's dead
+        stream endpoint, or a stale device registry descriptor)."""
+        stale = {
+            "stream": names.weight_stream,
+            "device": names.weight_device,
+        }
+        stale.pop(keep, None)
+        for fn in stale.values():
+            try:
+                name_resolve.delete(
+                    fn(self.cfg.experiment, self.cfg.trial, role)
+                )
+            except Exception:  # noqa: BLE001 — normally absent
+                pass
 
     def _bump_version(self, role: str, version: int,
                       publish_secs: float) -> None:

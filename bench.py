@@ -223,6 +223,27 @@ def main():
         if publisher is not None:
             publisher.close()
 
+    # The device transport measured next to it (same params, same chip):
+    # reshard-in-place publish + digest-gated consume through the
+    # in-process registry (parallel/reshard.py) — no d2h, no wire, no h2d.
+    # On a colocated single mesh the publish is a zero-copy plan walk, so
+    # this number is the transport's floor; heterogeneous layouts add the
+    # grouped on-device moves (tools/perf_probe.py reshard-bench sweeps
+    # those).
+    from areal_tpu.parallel import reshard as rsh
+
+    t0 = time.perf_counter()
+    dev_pub = rsh.publish_device(
+        "bench", "b0", "actor", pub,
+        target_shardings=rsh.shardings_of(pub), version=1,
+    )
+    got = rsh.consume_device(
+        "bench", "b0", "actor", 1, dev_pub.digest, pub
+    )
+    jax.block_until_ready(got)
+    weight_sync_device_s = time.perf_counter() - t0
+    rsh.clear_publication("bench", "b0", "actor")
+
     # Roofline context over the bf16 peak of one chip. The 6·N·T train
     # FLOPs estimate and the per-generation peak table live in
     # base/monitor.py — ONE accounting shared with the live trainer's
@@ -244,11 +265,13 @@ def main():
         "weight_sync_latency_s": round(weight_sync_s, 3),
         "weight_sync_io_s": round(weight_sync_io_s, 3),
         "weight_sync_transport_s": round(weight_sync_transport_s, 3),
-        # METHOD CHANGE vs r5: the streamed transport is measured end to
-        # end — d2h gather, wire, AND h2d upload, pipelined — with no disk
-        # round-trip (r5 measured disk io + d2h and extrapolated h2d as
-        # 2× d2h). See docs/benchmarks.md for the discontinuity note.
-        "weight_sync_transport_method": "streamed-measured",
+        "weight_sync_device_s": round(weight_sync_device_s, 3),
+        # METHOD CHANGE vs r6: the device transport (on-device reshard
+        # publish + digest-gated consume) is measured ALONGSIDE the
+        # streamed path — weight_sync_latency_s still names the streamed
+        # number (r6 continuity), weight_sync_device_s is the new
+        # transport. See docs/benchmarks.md for the discontinuity note.
+        "weight_sync_transport_method": "streamed+device-measured",
     }
     if train_phases is not None:
         # Phase fields are a measurement-method ADDITION (AREAL_TELEMETRY=1

@@ -105,8 +105,12 @@ def test_async_rollout_stack(env):
         name_resolve.add(
             names.model_version(EXP, TRIAL, "actor"), "1", replace=True
         )
+        # Wait for BOTH: the server swaps inside its POST handler, but the
+        # manager records the ack (and bumps its version) only when its
+        # fanout coroutine resumes — sampling mgr.version the instant the
+        # server flips races that one scheduling slot on the shared loop.
         for _ in range(50):
-            if server.version == 1:
+            if server.version == 1 and mgr.version == 1:
                 break
             await asyncio.sleep(0.1)
         assert server.version == 1 and mgr.version == 1

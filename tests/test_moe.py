@@ -171,3 +171,98 @@ def test_moe_generation_parity_with_forward():
     )
     for i in range(4):
         assert int(jnp.argmax(logits[0, 3 + i])) == int(toks[i])
+
+
+def test_router_jitter_rng_path():
+    """Router input jitter (input_jitter_eps > 0): an rng key perturbs the
+    routing, rng=None routes on the clean input (inference contract), and
+    eps=0 with a key is bit-identical to the no-key path."""
+    rng = np.random.RandomState(0)
+    D, F, E = 16, 32, 4
+    x = jnp.asarray(rng.randn(2, 12, D).astype(np.float32))
+    lp = {
+        "router": jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.5),
+        "e_gate": jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.1),
+        "e_up": jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.1),
+        "e_down": jnp.asarray(rng.randn(E, F, D).astype(np.float32) * 0.1),
+    }
+    moe_j = _moe_cfg(input_jitter_eps=0.2)
+    y_clean, _ = moemod.moe_mlp(x, lp, moe_j)  # rng=None: jitter off
+    y_clean2, _ = moemod.moe_mlp(x, lp, moe_j)
+    assert jnp.array_equal(y_clean, y_clean2)  # deterministic without a key
+    y_a, _ = moemod.moe_mlp(x, lp, moe_j, rng=jax.random.PRNGKey(1))
+    y_b, _ = moemod.moe_mlp(x, lp, moe_j, rng=jax.random.PRNGKey(2))
+    assert not jnp.array_equal(y_a, y_b)  # different keys, different jitter
+    assert not jnp.array_equal(y_a, y_clean)
+    # eps=0: the key is dead weight, output bit-identical to no-key
+    moe_0 = _moe_cfg(input_jitter_eps=0.0)
+    y0, _ = moemod.moe_mlp(x, lp, moe_0)
+    y0k, _ = moemod.moe_mlp(x, lp, moe_0, rng=jax.random.PRNGKey(1))
+    assert jnp.array_equal(y0, y0k)
+
+
+def test_forward_threads_jitter_rng():
+    """transformer.forward(rng=...) reaches the per-layer routers on the
+    training path (return_kv=False, the one train steps run): outputs
+    differ across keys, and rng=None keeps today's bit-identical scan."""
+    cfg = tiny_config(moe=_moe_cfg(input_jitter_eps=0.2))
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.randint(2, 64, (2, 8)).astype(np.int32))
+    pos = jnp.tile(jnp.arange(8), (2, 1))
+    seg = jnp.ones((2, 8), jnp.int32)
+
+    def fwd(rng=None):
+        out, _ = transformer.forward(params, cfg, toks, pos,
+                                     segment_ids=seg, return_kv=False,
+                                     rng=rng)
+        return out
+
+    assert jnp.array_equal(fwd(), fwd())  # no key → deterministic
+    j1 = fwd(jax.random.PRNGKey(1))
+    j2 = fwd(jax.random.PRNGKey(2))
+    assert not jnp.array_equal(j1, j2)
+    # the KV-returning (inference) path ignores the jitter by design
+    kv1, _ = transformer.forward(params, cfg, toks, pos, segment_ids=seg)
+    kv2, _ = transformer.forward(params, cfg, toks, pos, segment_ids=seg)
+    assert jnp.array_equal(kv1, kv2)
+
+
+def test_engine_train_step_with_jitter():
+    """input_jitter_eps > 0 trains end to end through the engine: the train
+    step threads a per-micro-batch key (backend/jax_train.py) instead of
+    raising, the loss is finite, and the router still learns."""
+    from areal_tpu.algorithms.sft import SFTInterface
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.api.model import FinetuneSpec, Model
+    from areal_tpu.backend.jax_train import JaxTrainBackend, OptimizerConfig
+
+    cfg = tiny_config(moe=_moe_cfg(input_jitter_eps=0.1))
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    model = Model("actor", (cfg, params), tokenizer=None)
+    backend = JaxTrainBackend(
+        optimizer=OptimizerConfig(lr=1e-3, lr_scheduler_type="constant"),
+        compute_dtype="float32", length_bucket=16, rows_bucket=2,
+        seqs_bucket=4,
+    )
+    model = backend.initialize(model, FinetuneSpec(1, 8, 4))
+    rng = np.random.RandomState(0)
+    seqlens = [12, 9, 15, 7]
+    total = sum(seqlens)
+    batch = SequenceSample.from_default(
+        ids=[str(i) for i in range(4)],
+        data={
+            "packed_input_ids": rng.randint(2, 128, total).astype(np.int32),
+            "prompt_mask": np.concatenate(
+                [np.r_[np.ones(3, np.int32), np.zeros(n - 3, np.int32)]
+                 for n in seqlens]),
+        },
+        seqlens=seqlens,
+    )
+    iface = SFTInterface()
+    before = jax.device_get(model.module.params["layers"]["router"])
+    stats = iface.train_step(model, batch,
+                             MicroBatchSpec(max_tokens_per_mb=64))
+    assert np.isfinite(stats["loss"])
+    assert "moe_aux_total" in stats and np.isfinite(stats["moe_aux_total"])
+    after = jax.device_get(model.module.params["layers"]["router"])
+    assert not np.allclose(before, after)

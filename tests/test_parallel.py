@@ -43,6 +43,44 @@ def test_allocation_mode_parse():
     assert am.per_mfc["actor_gen"].dp == 4 and am.global_spec.fsdp == 4
 
 
+def test_allocation_mode_parse_per_mfc_edge_cases():
+    # round trip: every named MFC keeps its own spec, str() re-parses
+    am = pmesh.AllocationMode.parse("actor_train:f2t2,ref_inf:d2,rew_inf:d1")
+    assert sorted(am.per_mfc) == ["actor_train", "ref_inf", "rew_inf"]
+    for name, spec in am.per_mfc.items():
+        assert pmesh.ParallelSpec.parse(str(spec)) == spec, name
+    # actor_train steers the global spec; actor_gen becomes gen_spec
+    am = pmesh.AllocationMode.parse("ref_inf:d2,actor_train:f4t2,actor_gen:d4")
+    assert am.global_spec.fsdp == 4 and am.decoupled and am.gen_spec.dp == 4
+    # whitespace around entries and names is tolerated
+    am = pmesh.AllocationMode.parse("  actor_train:f2t2 , ref_inf:d2  ")
+    assert am.per_mfc["ref_inf"].dp == 2
+    # decoupled '+' forms with and without engine prefixes
+    am = pmesh.AllocationMode.parse("d4+f2t4")
+    assert am.decoupled and am.gen_spec.dp == 4 and am.global_spec.tp == 4
+    # duplicate MFC names are an error, not a silent overwrite
+    with pytest.raises(ValueError, match="duplicate MFC 'ref_inf'"):
+        pmesh.AllocationMode.parse("ref_inf:d2,ref_inf:d4")
+    # malformed entries name the offending part
+    with pytest.raises(ValueError, match="malformed per-MFC"):
+        pmesh.AllocationMode.parse("actor_train:f2t2,ref_inf:")
+    with pytest.raises(ValueError, match="malformed per-MFC"):
+        pmesh.AllocationMode.parse(":d2")
+
+
+def test_spec_for_role_resolution():
+    from areal_tpu.experiments import common as C
+
+    am = pmesh.AllocationMode.parse("actor_train:f2t2,ref_inf:d2")
+    assert str(C.spec_for_role(am, "actor")) == "f2t2"
+    assert str(C.spec_for_role(am, "ref")) == "d2"
+    # roles without an override inherit the global (= actor_train) spec
+    assert str(C.spec_for_role(am, "critic")) == "f2t2"
+    # the train MFC wins over the inf MFC for the same role
+    am = pmesh.AllocationMode.parse("actor_inf:d4,actor_train:f2t2")
+    assert str(C.spec_for_role(am, "actor")) == "f2t2"
+
+
 def test_make_mesh_axes():
     m = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
     assert m.axis_names == pmesh.AXIS_ORDER
@@ -94,6 +132,42 @@ def test_sharded_grad_runs():
 
     g = jax.jit(jax.grad(loss))(sp)
     assert jnp.isfinite(jax.tree.reduce(lambda a, b: a + jnp.sum(b), g, 0.0))
+
+
+@pytest.mark.reshard
+def test_per_mfc_submesh_reshard_matches_colocated():
+    """Heterogeneous per-MFC meshes (e.g. actor_train:f2t2,ref_inf:d2):
+    params trained on the actor's f2t2 mesh, moved across the MFC
+    boundary by parallel/reshard.py onto ref's own d2 sub-mesh, must
+    produce the same forward outputs as the colocated single-mesh run."""
+    from areal_tpu.parallel import reshard as rsh
+
+    cfg = tiny_config(n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    B, T = 4, 16
+    tokens = np.random.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    positions = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+    seg = np.ones((B, T), np.int32)
+    ref_out, _ = transformer.forward(
+        params, cfg, tokens, positions, segment_ids=seg
+    )
+
+    actor_mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse("f2t2"))
+    sp = psh.shard_params(params, actor_mesh, cfg)
+
+    ref_mesh = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2"))
+    dst = psh.named_shardings(ref_mesh, psh.param_partition_specs(cfg))
+    moved, plan = rsh.reshard_pytree(sp, dst)
+    assert plan.n_moved > 0
+
+    def fwd(p, t, pos, s):
+        with psh.activation_sharding(ref_mesh):
+            out, _ = transformer.forward(p, cfg, t, pos, segment_ids=s)
+        return out
+
+    out = jax.jit(fwd)(moved, tokens, positions, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=2e-4)
 
 
 def test_shard_params_gpt2_family_on_mesh():

@@ -1,0 +1,199 @@
+"""Mesh→mesh on-device reshard tests (parallel/reshard.py).
+
+The resharding core behind the ``device`` weight-sync transport and
+heterogeneous per-MFC meshes: plan correctness (zero-copy recognition,
+transfer-group bounding), value preservation across layout changes on the
+8-virtual-device CPU platform, and the publish/consume registry's
+version + digest gates.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.parallel import reshard as rsh
+from areal_tpu.parallel.mesh import ParallelSpec, make_mesh
+
+pytestmark = pytest.mark.reshard
+
+
+def _tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "embedding": jnp.asarray(rng.randn(32, 8).astype(np.float32)),
+        "layers": {
+            "wq": jnp.asarray(rng.randn(2, 8, 8).astype(np.float32)),
+            "w_up": jnp.asarray(rng.randn(2, 8, 16).astype(np.float32)),
+        },
+        "final_ln": jnp.asarray(rng.randn(8).astype(np.float32)),
+    }
+
+
+def _shardings(mesh):
+    return {
+        "embedding": NamedSharding(mesh, P("fsdp", "tp")),
+        "layers": {
+            "wq": NamedSharding(mesh, P(None, "fsdp", "tp")),
+            "w_up": NamedSharding(mesh, P(None, "fsdp", "tp")),
+        },
+        "final_ln": NamedSharding(mesh, P()),
+    }
+
+
+def _place(tree, shardings):
+    placed = jax.tree.map(jax.device_put, tree, shardings)
+    jax.block_until_ready(placed)
+    return placed
+
+
+def _assert_trees_equal(a, b):
+    fa, fb = rsh._flatten(a), rsh._flatten(b)
+    assert set(fa) == set(fb)
+    for name in fa:
+        np.testing.assert_array_equal(np.asarray(fa[name]),
+                                      np.asarray(fb[name]), err_msg=name)
+
+
+@pytest.mark.parametrize("src,dst", [
+    ("d4", "t4"),           # dp → tp
+    ("t4", "d4"),           # tp → dp
+    ("f4", "d1"),           # fsdp → replicated-ish single device spec
+    ("f2t2", "d2f2"),       # mixed 2D → 2D
+])
+def test_reshard_values_survive_layout_change(src, dst):
+    tree = _tree()
+    src_placed = _place(tree, _shardings(make_mesh(ParallelSpec.parse(src))))
+    dst_sh = _shardings(make_mesh(ParallelSpec.parse(dst)))
+    out, plan = rsh.reshard_pytree(src_placed, dst_sh)
+    assert plan.n_moved > 0
+    _assert_trees_equal(out, tree)
+    # every leaf actually landed in the target sharding
+    for name, leaf in rsh._flatten(out).items():
+        want = rsh._flatten(dst_sh)[name]
+        assert leaf.sharding.is_equivalent_to(want, len(leaf.shape)), name
+
+
+def test_same_spec_is_zero_copy_noop():
+    mesh = make_mesh(ParallelSpec.parse("f2t2"))
+    placed = _place(_tree(), _shardings(mesh))
+    out, plan = rsh.reshard_pytree(placed, _shardings(mesh))
+    assert plan.n_moved == 0 and not plan.groups
+    # identical leaves are passed through as the SAME array objects
+    fo, fp = rsh._flatten(out), rsh._flatten(placed)
+    for name in fp:
+        assert fo[name] is fp[name], name
+
+
+def test_plan_groups_bound_bytes():
+    mesh = make_mesh(ParallelSpec.parse("d4"))
+    tgt = make_mesh(ParallelSpec.parse("t4"))
+    tree = {f"w{i}": jnp.zeros((16, 8), jnp.float32) for i in range(10)}
+    sh_src = {k: NamedSharding(mesh, P("dp", None)) for k in tree}
+    sh_dst = {k: NamedSharding(tgt, P(None, "tp")) for k in tree}
+    placed = _place(tree, sh_src)
+    leaf_bytes = 16 * 8 * 4
+    plan = rsh.plan_reshard(rsh._flatten(placed), sh_dst,
+                            group_bytes=2 * leaf_bytes)
+    assert plan.n_moved == 10
+    assert len(plan.groups) == 5  # 2 leaves per group at a 2-leaf budget
+    for g in plan.groups:
+        assert sum(rsh._leaf_nbytes(placed[n]) for n in g) <= 2 * leaf_bytes
+    out = rsh.execute_reshard(rsh._flatten(placed), sh_dst, plan)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(tree[k]))
+
+
+def test_plan_rejects_tree_mismatch():
+    mesh = make_mesh(ParallelSpec.parse("d2"))
+    a = {"x": jnp.zeros((4,))}
+    with pytest.raises(ValueError, match="differ"):
+        rsh.plan_reshard(a, {"y": NamedSharding(mesh, P())})
+
+
+def test_host_path_matches_device_path():
+    src_placed = _place(_tree(), _shardings(make_mesh(ParallelSpec.parse("d4"))))
+    dst_sh = _shardings(make_mesh(ParallelSpec.parse("t4")))
+    via_dev, _ = rsh.reshard_pytree(src_placed, dst_sh)
+    via_host = rsh.reshard_via_host(src_placed, dst_sh)
+    _assert_trees_equal(via_dev, via_host)
+
+
+def test_manifest_digest_is_stable_and_version_bound():
+    flat = rsh._flatten(_place(_tree(), _shardings(
+        make_mesh(ParallelSpec.parse("d2")))))
+    m = rsh.build_manifest(flat)
+    assert rsh.manifest_digest(m, 3) == rsh.manifest_digest(m, 3)
+    assert rsh.manifest_digest(m, 3) != rsh.manifest_digest(m, 4)
+
+
+def test_publish_consume_roundtrip(tmp_name_resolve):
+    from areal_tpu.base import name_resolve, names
+
+    tree = _tree(seed=7)
+    src = _place(tree, _shardings(make_mesh(ParallelSpec.parse("f2t2"))))
+    live = _place(_tree(seed=8), _shardings(make_mesh(ParallelSpec.parse("d4"))))
+    pub = rsh.publish_device(
+        "exp", "t0", "actor", src,
+        target_shardings=rsh.shardings_of(live), version=5,
+    )
+    # discovery key carries the out-of-band version + digest
+    desc = json.loads(name_resolve.get(names.weight_device("exp", "t0", "actor")))
+    assert desc["version"] == 5 and desc["digest"] == pub.digest
+
+    got = rsh.consume_device("exp", "t0", "actor", 5, pub.digest, live)
+    _assert_trees_equal(got, tree)  # publisher's values, consumer's layout
+    for name, leaf in rsh._flatten(got).items():
+        live_leaf = rsh._flatten(live)[name]
+        assert leaf.sharding.is_equivalent_to(
+            live_leaf.sharding, len(leaf.shape)), name
+
+    with pytest.raises(rsh.DeviceReshardError, match="version skew"):
+        rsh.consume_device("exp", "t0", "actor", 6, pub.digest, live)
+    with pytest.raises(rsh.DeviceReshardError, match="digest"):
+        rsh.consume_device("exp", "t0", "actor", 5, "deadbeef", live)
+    with pytest.raises(rsh.DeviceReshardError, match="tree mismatch"):
+        rsh.consume_device("exp", "t0", "actor", 5, pub.digest,
+                           {"other": live["embedding"]})
+
+    rsh.clear_publication("exp", "t0", "actor")
+    assert rsh.lookup_publication("exp", "t0", "actor") is None
+    with pytest.raises(rsh.DeviceReshardError, match="no device publication"):
+        rsh.consume_device("exp", "t0", "actor", 5, pub.digest, live)
+
+
+def test_consume_missing_publication_raises(tmp_name_resolve):
+    live = _place(_tree(), _shardings(make_mesh(ParallelSpec.parse("d2"))))
+    with pytest.raises(rsh.DeviceReshardError, match="share one JAX runtime"):
+        rsh.consume_device("nope", "t0", "actor", 1, "0" * 8, live)
+
+
+def test_consume_casts_to_live_dtype(tmp_name_resolve):
+    tree = _tree(seed=3)
+    bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), tree)
+    src = _place(bf16, _shardings(make_mesh(ParallelSpec.parse("d2"))))
+    live = _place(tree, _shardings(make_mesh(ParallelSpec.parse("t2"))))
+    pub = rsh.publish_device("exp", "t1", "actor", src,
+                             target_shardings=rsh.shardings_of(src), version=1)
+    got = rsh.consume_device("exp", "t1", "actor", 1, pub.digest, live)
+    for name, leaf in rsh._flatten(got).items():
+        assert leaf.dtype == jnp.float32, name
+    rsh.clear_publication("exp", "t1", "actor")
+
+
+def test_latest_wins_registry(tmp_name_resolve):
+    src = _place(_tree(), _shardings(make_mesh(ParallelSpec.parse("d2"))))
+    rsh.publish_device("exp", "t2", "actor", src,
+                       target_shardings=rsh.shardings_of(src), version=1)
+    pub2 = rsh.publish_device("exp", "t2", "actor", src,
+                              target_shardings=rsh.shardings_of(src),
+                              version=2)
+    assert rsh.lookup_publication("exp", "t2", "actor").version == 2
+    # the old fanout (v1) now fails the version gate instead of swapping
+    with pytest.raises(rsh.DeviceReshardError, match="version skew"):
+        rsh.consume_device("exp", "t2", "actor", 1, pub2.digest, src)
+    rsh.clear_publication("exp", "t2", "actor")

@@ -738,3 +738,160 @@ def test_async_ppo_full_loop(tmp_path):
         trainer.join(timeout=10)
         fleet.join(timeout=10)
         reward_proc.join(timeout=10)
+
+
+# ------------------ device-transport weight bump (in-process e2e) ------
+
+
+@pytest.mark.reshard
+@pytest.mark.timeout(120)
+def test_device_transport_weight_bump_e2e(tmp_name_resolve):
+    """One weight bump over weight_sync.transport=device, end to end on
+    CPU meshes: the trainer reshards its live params into the generation
+    fleet's layout ON DEVICE and registers the publication; the manager's
+    fanout auto-detects the device descriptor over disk; the server's
+    swap stays digest-gated and atomic (a forged digest 500s with the old
+    pair still live); and the trainer's goodput ledger attributes the
+    publish to goodput/secs{state=comm} on the live scrape. In-process by
+    construction — the device transport requires publisher and consumers
+    to share one JAX runtime (docs/weight_sync.md §device); the
+    cross-process fleets above keep using stream/disk."""
+    import asyncio
+    import json as _json
+    import os
+
+    import jax
+
+    import areal_tpu.backend.jax_train  # noqa: F401 — registers "jax_train"
+    from areal_tpu.api.model import FinetuneSpec, make_backend
+    from areal_tpu.api.train_config import WeightSyncConfig
+    from areal_tpu.base import names, telemetry
+    from areal_tpu.base.retry import RetryPolicy
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.hf import flatten_pytree
+    from areal_tpu.parallel import reshard as rsh
+    from areal_tpu.system import goodput
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+        _ServerHealth,
+    )
+    from areal_tpu.system.trainer_worker import (
+        ModelRoleConfig,
+        TrainerWorker,
+        TrainerWorkerConfig,
+    )
+
+    cfg = TrainerWorkerConfig(
+        experiment=EXP, trial=TRIAL,
+        models={"actor": ModelRoleConfig(
+            init={"tiny": {"vocab_size": 258}},
+            backend_args={"compute_dtype": "float32", "length_bucket": 16},
+        )},
+        ft_spec=FinetuneSpec(1, 32, 8),
+        realloc_dir="/nonexistent/never/written",
+        weight_sync=WeightSyncConfig(transport="device"),
+    )
+    w = TrainerWorker(cfg)
+    for role, rc in cfg.models.items():
+        backend = make_backend(rc.backend, train=rc.train, **rc.backend_args)
+        w.models[role] = backend.initialize(
+            w._model_factory(role, rc), cfg.ft_spec
+        )
+    # Arm a real ledger on a private registry: _publish_weights_device
+    # runs under state("comm"), and the flush below must surface that on
+    # the scrape (the worker's own ledger is wired identically in setup()).
+    reg = telemetry.TelemetryRegistry()
+    w._ledger = goodput.GoodputLedger(reg, export_interval_secs=0.0)
+
+    # Make the bump observable: perturb the trainer's weights away from
+    # the generation server's init, and move the version off 0.
+    engine = w.models["actor"].module
+    engine.params = jax.tree.map(
+        lambda x: x * 1.25 if x.dtype == np.float32 else x, engine.params
+    )
+    w.models["actor"].version.global_step = 3
+
+    mcfg = tiny_config(vocab_size=258)  # same shapes as the tiny actor
+    server = GenerationServer(
+        GenerationServerConfig(experiment=EXP, trial=TRIAL, chunk_tokens=4,
+                               prompt_bucket=16, batch_window_ms=2),
+        mcfg, transformer.init_params(mcfg, jax.random.PRNGKey(1)),
+    )
+
+    async def main():
+        import aiohttp
+
+        url = await server.start()
+        try:
+            w.publish_weights("actor")
+            # discovery: descriptor + version key, no checkpoint anywhere
+            desc = _json.loads(name_resolve.get(
+                names.weight_device(EXP, TRIAL, "actor")))
+            assert desc["version"] == 3 and desc["digest"]
+            assert int(name_resolve.get(
+                names.model_version(EXP, TRIAL, "actor"))) == 3
+            assert not os.path.exists("/nonexistent/never/written")
+
+            mgr = GserverManager(GserverManagerConfig(
+                experiment=EXP, trial=TRIAL, fanout_timeout_secs=5.0,
+                fanout_retry=RetryPolicy(max_attempts=2,
+                                         base_delay_secs=0.01),
+            ))
+            mgr.servers = [url]
+            mgr._inflight = {url: 0}
+            mgr.health = {url: _ServerHealth()}
+            async with aiohttp.ClientSession() as sess:
+                # transport auto-detection routes at the device
+                # publication, not the (nonexistent) disk checkpoint
+                payload = mgr._update_payload(3, "/unused/disk/path")
+                assert payload.get("device") is True
+                assert payload["digest"] == desc["digest"]
+                acked = await mgr.fanout_weights(sess, 3,
+                                                 "/unused/disk/path")
+                assert acked == [url] and mgr.version == 3
+                assert server.version == 3
+
+                # gen-side params: bit-identical to the trainer's
+                # compute-dtype tree
+                want = flatten_pytree(w._compute_dtype_params("actor"),
+                                      as_numpy=True)
+                got = flatten_pytree(server.params, as_numpy=True)
+                assert set(got) == set(want)
+                for k in want:
+                    np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+                # digest gate: a forged fanout 500s and the just-swapped
+                # (params, version) pair stays live
+                async with sess.post(f"{url}/update_weights", json={
+                    "device": True, "role": "actor",
+                    "version": 3, "digest": "deadbeef",
+                }) as r:
+                    assert r.status == 500
+                async with sess.get(f"{url}/metrics.json") as r:
+                    assert (await r.json())["version"] == 3
+                after = flatten_pytree(server.params, as_numpy=True)
+                for k in want:
+                    np.testing.assert_array_equal(after[k], want[k])
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        rsh.clear_publication(EXP, TRIAL, "actor")
+
+    # live scrape: the on-device publish accrued into the comm state
+    w._ledger.flush()
+    body = telemetry.render_prometheus(reg.snapshot(reset=False),
+                                       labels={"kind": "trainer"})
+    comm = [ln for ln in body.splitlines()
+            if ln.startswith("areal_goodput_secs_total")
+            and 'state="comm"' in ln]
+    assert comm, body
+    assert float(comm[0].rpartition(" ")[2]) > 0.0

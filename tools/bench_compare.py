@@ -19,6 +19,7 @@ with per-field relative tolerances:
   weight_sync_latency_s      lower      15%
   weight_sync_io_s           lower      25%
   weight_sync_transport_s    lower      25%
+  weight_sync_device_s       lower      25%
   train_phases.*             lower      25%
 
 Exit status 0 when every comparable field is within tolerance, 1 on any
@@ -55,6 +56,7 @@ FIELDS: Dict[str, Tuple[str, float]] = {
     "weight_sync_latency_s": ("lower", 0.15),
     "weight_sync_io_s": ("lower", 0.25),
     "weight_sync_transport_s": ("lower", 0.25),
+    "weight_sync_device_s": ("lower", 0.25),
 }
 TRAIN_PHASE_SPEC = ("lower", 0.25)
 METHOD_FIELD = "weight_sync_transport_method"

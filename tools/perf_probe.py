@@ -19,6 +19,17 @@ Standalone probes (docs/benchmarks.md Tools):
                                       load it via AREAL_FLASH_BLOCK_TABLE)
                                       — needs a real TPU: the kernel has
                                       no interpreter on this jax
+  reshard-bench [src] [dst] [mb] [layers] [dim]
+                                      time the mesh→mesh on-device
+                                      reshard (parallel/reshard.py):
+                                      build a synthetic stacked-layer
+                                      tree, move it src-spec → dst-spec
+                                      (default f2t2 → d4) and report the
+                                      plan plus per-transfer-group
+                                      throughput at the given group
+                                      budget (default 64 MB); runs on
+                                      CPU test meshes or real chips
+                                      (docs/weight_sync.md §device)
 
 Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
@@ -985,13 +996,95 @@ def blocksweep(T: int = 1792, S: int = 1792, out_path: str = None,
           f"(use: AREAL_FLASH_BLOCK_TABLE={out_path})")
 
 
+def reshard_bench(src_spec: str = "f2t2", dst_spec: str = "d4",
+                  group_mb: int = 64, n_layers: int = 8,
+                  dim: int = 1024) -> None:
+    """Time the mesh→mesh on-device reshard (parallel/reshard.py) between
+    two ParallelSpecs on whatever devices this process has (CPU test
+    meshes under JAX_PLATFORMS=cpu, real chips otherwise): per
+    transfer-group dispatch→barrier latency and MB/s, plus the end-to-end
+    publish figure the ``device`` weight-sync transport would pay."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.parallel import mesh as pm
+    from areal_tpu.parallel import reshard as rsh
+    from areal_tpu.parallel import sharding as psh
+
+    src = pm.ParallelSpec.parse(src_spec)
+    dst = pm.ParallelSpec.parse(dst_spec)
+    n_dev = len(jax.devices())
+    for label, spec in (("src", src), ("dst", dst)):
+        if spec.world_size > n_dev:
+            sys.exit(f"reshard-bench: {label} spec '{spec}' needs "
+                     f"{spec.world_size} devices, have {n_dev} "
+                     f"(JAX_PLATFORMS=cpu + "
+                     f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                     f"for a host-mesh dry run)")
+    src_mesh, dst_mesh = pm.make_mesh(src), pm.make_mesh(dst)
+    # Transformer-shaped synthetic tree: a stacked layer dict sharded the
+    # way training shards it, so the plan exercises the real per-leaf
+    # PartitionSpecs rather than a flat blob.
+    tree = {
+        "layers": {
+            "wq": jnp.zeros((n_layers, dim, dim), jnp.bfloat16),
+            "wo": jnp.zeros((n_layers, dim, dim), jnp.bfloat16),
+            "w_up": jnp.zeros((n_layers, dim, 4 * dim), jnp.bfloat16),
+            "w_down": jnp.zeros((n_layers, 4 * dim, dim), jnp.bfloat16),
+        },
+        "embedding": jnp.zeros((4096, dim), jnp.bfloat16),
+    }
+    specs = jax.tree.map(lambda _: None, tree)
+    specs["layers"] = {
+        "wq": psh.P(None, "fsdp", "tp"), "wo": psh.P(None, "tp", "fsdp"),
+        "w_up": psh.P(None, "fsdp", "tp"),
+        "w_down": psh.P(None, "tp", "fsdp"),
+    }
+    specs["embedding"] = psh.P("fsdp", "tp")
+    src_sh = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(src_mesh, s or psh.P()), specs,
+        is_leaf=lambda x: x is None or isinstance(x, psh.P),
+    )
+    dst_sh = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(dst_mesh, s or psh.P()), specs,
+        is_leaf=lambda x: x is None or isinstance(x, psh.P),
+    )
+    tree = jax.tree.map(jax.device_put, tree, src_sh)
+    jax.block_until_ready(tree)
+    flat_src = rsh._flatten(tree)
+    flat_dst = rsh._flatten(dst_sh)
+    plan = rsh.plan_reshard(flat_src, flat_dst,
+                            group_bytes=int(group_mb) << 20)
+    print(f"[reshard-bench] {src} -> {dst} on {n_dev} "
+          f"{jax.devices()[0].platform} devices: "
+          f"{plan.total_bytes >> 20} MB total, plan {plan.describe()}")
+    t_all = time.perf_counter()
+    for gi, group in enumerate(plan.groups):
+        g_bytes = sum(rsh._leaf_nbytes(flat_src[n]) for n in group)
+        t0 = time.perf_counter()
+        rsh._move_group(group, flat_src, flat_dst)
+        dt = time.perf_counter() - t0
+        print(f"[reshard-bench] group {gi}: {len(group)} leaves, "
+              f"{g_bytes >> 20:>5} MB, {dt * 1e3:8.2f} ms, "
+              f"{g_bytes / dt / 2 ** 20:10.1f} MB/s")
+    dt_all = time.perf_counter() - t_all
+    t0 = time.perf_counter()
+    _, plan2 = rsh.reshard_pytree(tree, dst_sh, group_mb=int(group_mb))
+    dt_pub = time.perf_counter() - t0
+    mbs = plan.moved_bytes / 2 ** 20
+    print(f"[reshard-bench] grouped total: {dt_all * 1e3:.2f} ms "
+          f"({mbs / max(dt_all, 1e-9):.1f} MB/s moved); "
+          f"end-to-end reshard_pytree: {dt_pub * 1e3:.2f} ms "
+          f"(zero-copy leaves: {len(plan2.identical)})")
+
+
 def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
                                    "flight-dump", "packfill", "blocksweep",
                                    "profile-trigger", "profile-status",
                                    "fleet-status", "drain", "cordon",
                                    "uncordon", "reward-bench", "alerts",
-                                   "silence", "goodput"):
+                                   "silence", "goodput", "reshard-bench"):
         return False
     cmd = argv[0]
     try:
@@ -1048,6 +1141,14 @@ def _dispatch_fleet_commands(argv) -> bool:
             else:
                 goodput_view(argv[1], argv[2], window_secs=(
                     float(argv[3]) if len(argv) > 3 else 5.0))
+        elif cmd == "reshard-bench":
+            reshard_bench(
+                argv[1] if len(argv) > 1 else "f2t2",
+                argv[2] if len(argv) > 2 else "d4",
+                int(argv[3]) if len(argv) > 3 else 64,
+                int(argv[4]) if len(argv) > 4 else 8,
+                int(argv[5]) if len(argv) > 5 else 1024,
+            )
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
                             float(argv[4]) if len(argv) > 4 else 5.0)
