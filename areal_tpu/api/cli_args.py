@@ -33,6 +33,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 # never drags in jax/optax (CPU-only children, `--help`).
 from areal_tpu.api.train_config import (  # noqa: F401
     AutoscaleConfig,
+    DurabilityConfig,
     ExperimentSaveEvalControl,
     FaultToleranceConfig,
     GoodputConfig,
@@ -251,6 +252,15 @@ class BaseExperimentConfig:
     # backpressure) and the launcher-side spawn executor.
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig
+    )
+    # Durable trajectory spool (docs/fault_tolerance.md §Data durability):
+    # off by default — `durability.enabled=true` turns on at-least-once
+    # rollout→trainer delivery: per-worker fsynced spool written before
+    # the prompt is marked consumed, trainer acks on optimizer-step
+    # commit (or durable drop), crash-replay with idempotent ingest.
+    # Disabled = today's fire-and-forget path, bit-identical wire bytes.
+    durability: DurabilityConfig = dataclasses.field(
+        default_factory=DurabilityConfig
     )
     # Sandboxed reward service (docs/rewards.md): off by default —
     # `reward_service.enabled=true` spawns the reward-worker fleet and
@@ -589,9 +599,35 @@ def validate_config(cfg) -> None:
         from areal_tpu.system.sentinel import rules_from_config
 
         try:
-            rules_from_config(sn)
+            rules_from_config(sn, durability_enabled=getattr(
+                getattr(cfg, "durability", None), "enabled", False
+            ))
         except ValueError as e:
             raise ConfigError(f"invalid sentinel rule pack: {e}") from None
+    dur = getattr(cfg, "durability", None)
+    if dur is not None and getattr(dur, "enabled", False):
+        if dur.spool_segment_bytes <= 0:
+            raise ConfigError(
+                f"durability.spool_segment_bytes="
+                f"{dur.spool_segment_bytes} must be > 0"
+            )
+        if dur.spool_max_bytes < dur.spool_segment_bytes:
+            raise ConfigError(
+                f"durability.spool_max_bytes={dur.spool_max_bytes} < "
+                f"spool_segment_bytes={dur.spool_segment_bytes}: the "
+                f"spool could never roll a full segment"
+            )
+        if dur.resend_timeout_secs <= 0:
+            raise ConfigError(
+                f"durability.resend_timeout_secs="
+                f"{dur.resend_timeout_secs} must be > 0 (it is the only "
+                f"recovery path for a lost ack)"
+            )
+        if dur.push_block_secs <= 0:
+            raise ConfigError(
+                f"durability.push_block_secs={dur.push_block_secs} must "
+                f"be > 0 (a zero budget fails every send at the HWM)"
+            )
     rs = getattr(cfg, "reward_service", None)
     if rs is not None and getattr(rs, "enabled", False):
         if rs.n_workers < 1:

@@ -414,6 +414,46 @@ class FaultToleranceConfig:
 
 
 @dataclasses.dataclass
+class DurabilityConfig:
+    """Durable rollout→trainer sample delivery (system/sample_spool.py,
+    docs/fault_tolerance.md §Data durability).
+
+    Enabled, every accepted trajectory is fsynced to a per-rollout-worker
+    append-only spool BEFORE its prompt is marked consumed, pushes carry
+    ``(worker_index, spool_seqno)``, and the trainer acks a seqno back
+    only once the sample is trained (optimizer step committed → the
+    master's freed-id "clear" forwarding) or durably dropped (too-stale
+    replay). A trainer/master death therefore costs replay, not samples:
+    the worker re-sends unacked records and the trainer ingests them
+    idempotently (dedup by sample id).
+
+    Off by default: no spool is created, no ``_spool`` key is injected,
+    and the push wire bytes are bit-identical to the non-durable format
+    (pinned by tests/test_sample_spool.py)."""
+
+    enabled: bool = False
+    # Spool segment roll size; acked prefixes are deleted whole-segment.
+    spool_segment_bytes: int = 8 * 1024 * 1024
+    # Total on-disk (and in-memory mirror) cap per worker. Appends past
+    # it block the submitting rollout — backpressure, not sample loss.
+    spool_max_bytes: int = 256 * 1024 * 1024
+    # A record unacked this long after its last send is re-sent with the
+    # replay flag (covers trainer restarts and lost acks).
+    resend_timeout_secs: float = 30.0
+    # Replayed samples re-enter a staleness gate at the trainer: a
+    # replay whose version_end lags the current trained version by more
+    # than this many versions is durably dropped (and acked), counted in
+    # spool/replay_stale_dropped. Negative disables the gate.
+    replay_staleness_limit: int = 8
+    # On clean worker exit, wait this long for in-flight acks so the
+    # spool drains instead of replaying next incarnation.
+    drain_timeout_secs: float = 5.0
+    # Bounded-retry budget for a blocked ZMQ push (streams.ZmqPusher);
+    # with durability on only the background sender ever blocks.
+    push_block_secs: float = 120.0
+
+
+@dataclasses.dataclass
 class ExperimentSaveEvalControl:
     """Reference cli_args.py:702."""
 

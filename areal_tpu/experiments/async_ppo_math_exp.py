@@ -110,6 +110,10 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 # reward callbacks grade over HTTP on the reward workers
                 # below instead of in the rollout process.
                 reward_service=self.reward_service,
+                # Durable trajectory spool (docs/fault_tolerance.md §Data
+                # durability): off by default; when enabled each worker
+                # spools under recover_dir before marking prompts consumed.
+                durability=self.durability,
             )
             for i in range(self.n_rollout_workers)
         ]

@@ -357,6 +357,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             telemetry=self._telemetry(),
             goodput=self.goodput,
             reward_service=self.reward_service,
+            durability=self.durability,
         )
 
     def build_master_config(self, async_mode: bool = False):
@@ -400,6 +401,9 @@ class PPOMATHConfig(BaseExperimentConfig):
             sentinel=self.sentinel,
             # Fleet-goodput stitching rides in the same aggregator.
             goodput=self.goodput,
+            # Arms the sentinel's sample_loss rule when the durable
+            # spool is on (the freed-id forwarding is the ack trigger).
+            durability=self.durability,
             recover_dir=paths["recover"],
             recover=self.recover_mode == "resume",
         )

@@ -58,7 +58,14 @@ class AsyncSequenceBuffer:
                     raise ValueError("buffer slots hold single samples")
                 sid = s.ids[0]
                 if sid in self._slots:
-                    raise ValueError(f"duplicate sample id {sid} in buffer")
+                    # At-least-once delivery (docs/fault_tolerance.md
+                    # §Data durability) makes duplicates a normal event,
+                    # not corruption: a resent trajectory that slipped
+                    # past the trainer's dedup must be skipped
+                    # idempotently — the live slot keeps its read state
+                    # untouched and the id does NOT re-enter _freed.
+                    telemetry.inc("buffer/duplicate_dropped")
+                    continue
                 if len(self._slots) >= self.max_size:
                     raise RuntimeError("buffer overflow")
                 self._slots[sid] = _Slot(

@@ -41,6 +41,7 @@ logger = logging.getLogger("system.master")
 # Canonical home is the dependency-free api.train_config; re-exported here
 # because this module historically defined it.
 from areal_tpu.api.train_config import (  # noqa: E402,F401
+    DurabilityConfig,
     ExperimentSaveEvalControl,
     GoodputConfig,
     SentinelConfig,
@@ -80,6 +81,13 @@ class MasterWorkerConfig:
     # split trainer vs generation) on the merged scrape. Off by default.
     goodput: GoodputConfig = dataclasses.field(
         default_factory=GoodputConfig
+    )
+    # Durable sample delivery (system/sample_spool.py): the master's
+    # interest is indirect — the freed-id forwarding below is the ack
+    # trigger, and with durability armed the sentinel gains the
+    # sample_loss absence rule on spool acks.
+    durability: DurabilityConfig = dataclasses.field(
+        default_factory=DurabilityConfig
     )
     # recover checkpoints (RecoverInfo + trainer train-state) live here
     recover_dir: str = ""
@@ -154,11 +162,22 @@ class MasterWorker:
                 # ingested snapshot, ticked from the ingest loop, no
                 # threads of its own. alerts.jsonl and the evidence dir
                 # default next to telemetry.jsonl.
-                from areal_tpu.system.sentinel import Sentinel
+                from areal_tpu.system.sentinel import (
+                    Sentinel,
+                    rules_from_config,
+                )
 
                 log_dir = os.path.dirname(jsonl) or "."
                 self._sentinel = Sentinel(
                     self.cfg.sentinel, self.cfg.experiment, self.cfg.trial,
+                    # The durability pack (sample_loss absence on spool
+                    # acks) arms only alongside the durable spool — on a
+                    # non-durable run the series never exists and an
+                    # absence rule would false-fire.
+                    rules=rules_from_config(
+                        self.cfg.sentinel,
+                        durability_enabled=self.cfg.durability.enabled,
+                    ),
                     alerts_path=(self.cfg.sentinel.alerts_path
                                  or os.path.join(log_dir, "alerts.jsonl")),
                     evidence_dir=(self.cfg.sentinel.evidence_dir

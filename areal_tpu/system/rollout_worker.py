@@ -22,6 +22,7 @@ import areal_tpu.agents  # noqa: F401 — registers built-in agents/envs
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.api.model import GenerationHyperparameters, make_agent
 from areal_tpu.api.train_config import (
+    DurabilityConfig,
     GoodputConfig,
     RewardServiceConfig,
     TelemetryConfig,
@@ -40,7 +41,12 @@ from areal_tpu.system.partial_rollout import (
     PartialRolloutClient,
     trajectory_from_gen,
 )
-from areal_tpu.system.streams import ZmqPusher
+from areal_tpu.system.sample_spool import (
+    SampleSpool,
+    SpoolSender,
+    ack_channel_name,
+)
+from areal_tpu.system.streams import ZmqPuller, ZmqPusher
 
 logger = logging.getLogger("system.rollout")
 
@@ -97,6 +103,14 @@ class RolloutWorkerConfig:
     # executing verification in THIS process. Off = legacy local grading.
     reward_service: RewardServiceConfig = dataclasses.field(
         default_factory=RewardServiceConfig
+    )
+    # Durable sample delivery (system/sample_spool.py): enabled, every
+    # accepted trajectory is fsynced to {recover_dir}/spool_{index}/
+    # BEFORE the prompt enters the ConsumedLog, and a background sender
+    # owns the push socket (acks, replay, resend). Off = the legacy
+    # fire-and-forget push, bit-identical wire bytes.
+    durability: DurabilityConfig = dataclasses.field(
+        default_factory=DurabilityConfig
     )
 
 
@@ -188,6 +202,7 @@ class RolloutWorker:
         self._done = 0
         self._pushed = 0
         self._abandoned = 0
+        self._sender: Optional[SpoolSender] = None  # armed by run_async
         # Goodput accounting (null until run_async arms it).
         self._ledger = goodput_mod.NULL_LEDGER
 
@@ -358,7 +373,15 @@ class RolloutWorker:
             # (env.step fanout or local grading) after the last chunk.
             self._ledger.add("compute", time.monotonic() - t_grade)
             for t in final:
-                pusher.push(t.as_json_compatible())
+                payload = t.as_json_compatible()
+                if self._sender is not None:
+                    # Durable path: fsynced into the spool (off the event
+                    # loop — the append blocks on disk, and on spool
+                    # backpressure) BEFORE ``one()`` marks the prompt
+                    # consumed; the sender thread owns the actual push.
+                    await asyncio.to_thread(self._sender.submit, payload)
+                else:
+                    pusher.push(payload)
                 if "version_start" in t.data:
                     # Version-staleness lag at submit: how many weight
                     # versions elapsed while this trajectory generated —
@@ -446,7 +469,36 @@ class RolloutWorker:
         self._mgr_url0 = name_resolve.wait(
             names.gen_server_manager(cfg.experiment, cfg.trial), timeout=300
         )
-        pusher = ZmqPusher(cfg.experiment, cfg.trial, cfg.trainer_handler)
+        pusher = ZmqPusher(
+            cfg.experiment, cfg.trial, cfg.trainer_handler,
+            block_secs=cfg.durability.push_block_secs,
+        )
+        ack_puller = None
+        if cfg.durability.enabled:
+            if not cfg.recover_dir:
+                raise ValueError(
+                    "durability.enabled=true needs a recover_dir: the "
+                    "spool must land next to the consumed-uid log so a "
+                    "respawned worker can replay it"
+                )
+            spool = SampleSpool(
+                os.path.join(cfg.recover_dir, f"spool_{cfg.worker_index}"),
+                segment_bytes=cfg.durability.spool_segment_bytes,
+                max_bytes=cfg.durability.spool_max_bytes,
+            )
+            # Ack channel: this worker binds its own PULL socket; the
+            # trainer discovers it by worker index and pushes settled
+            # seqnos back. Leased on the control heartbeat like every
+            # other advertisement (a SIGKILLed worker's key expires).
+            ack_puller = ZmqPuller(
+                cfg.experiment, cfg.trial, ack_channel_name(cfg.worker_index)
+            )
+            ctrl.lease(ack_puller._key, ack_puller._addr)
+            self._sender = SpoolSender(
+                spool, pusher, ack_puller, cfg.worker_index,
+                resend_timeout_secs=cfg.durability.resend_timeout_secs,
+            )
+            self._sender.start()
         async with aiohttp.ClientSession() as session:
             # Reward fanout rides this worker's long-lived session
             # (keepalive reuse across grade batches); the async-with
@@ -549,6 +601,14 @@ class RolloutWorker:
                 t.cancel()
             if pending:
                 await asyncio.gather(*pending, return_exceptions=True)
+        if self._sender is not None:
+            # Clean exit: give in-flight acks a bounded window to settle
+            # so the spool drains; anything unacked stays on disk and
+            # replays next incarnation (at-least-once, never lost).
+            await asyncio.to_thread(
+                self._sender.close, cfg.durability.drain_timeout_secs
+            )
+            ack_puller.close()
         ctrl.close()
         self.consumed.close()
         self._ledger.flush()

@@ -1,0 +1,582 @@
+"""Durable trajectory spool — at-least-once rollout→trainer delivery.
+
+The async data plane (streams.ZmqPusher → trainer ZmqPuller) is
+fire-and-forget: a trainer death destroys every in-flight trajectory
+while the rollout worker's ConsumedLog durably guarantees those prompts
+are never regenerated — permanent sample loss. This module closes the
+hole (docs/fault_tolerance.md §Data durability):
+
+ - :class:`SampleSpool` — per-rollout-worker append-only segment log.
+   Every accepted trajectory is fsynced here BEFORE the prompt is marked
+   consumed, so the crash-ordering invariant "consumed ⇒ spooled" holds
+   at every instruction boundary. Records carry a CRC and the reader
+   repairs a torn tail exactly like the ConsumedLog (a record that never
+   fully landed is dropped — safe: the prompt was not yet consumed).
+ - :class:`SpoolSender` — background thread that drains the spool to the
+   ZMQ push socket (non-blocking sends; a dead trainer can no longer
+   wedge the asyncio loop inside ``pusher.push``), receives acks on a
+   per-worker ack channel, truncates acked segment prefixes, and
+   re-sends records whose ack never arrived (trainer restart).
+ - :class:`SpoolIngest` — trainer-side idempotent ingest decision:
+   dedup by sample id (duplicates are a normal at-least-once event),
+   staleness gate for replays, and the trained/durably-dropped → ack
+   bookkeeping the trainer's "clear" handler drives.
+
+Wire compatibility: pushes gain an OPTIONAL ``_spool`` key
+(``{"w": worker_index, "seq": seqno}``, plus ``"r": 1`` on re-sends),
+mirroring the telemetry ``_trace`` contract — with durability disabled
+nothing is injected and the wire bytes are bit-identical to today's
+format (pinned by tests/test_sample_spool.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from areal_tpu.base import logging, telemetry
+
+logger = logging.getLogger("system.sample_spool")
+
+SPOOL_KEY = "_spool"
+
+# Record layout: 24-byte header + payload.
+#   >Q  seqno       (monotonic from 1; also the ack unit)
+#   >d  wall time   (oldest-unacked-age accounting survives restarts)
+#   >I  payload len
+#   >I  crc32 over (first 20 header bytes + payload)
+_HDR = struct.Struct(">QdI")
+_CRC = struct.Struct(">I")
+_HDR_BYTES = _HDR.size + _CRC.size
+
+
+def ack_channel_name(worker_index: int) -> str:
+    """name_resolve puller name for rollout worker ``worker_index``'s ack
+    channel (trainer pushes ``{"seqnos": [...]}`` dicts to it)."""
+    return f"spool_ack_{worker_index}"
+
+
+class SpoolFull(RuntimeError):
+    """Raised by ``append`` when the spool is at ``max_bytes`` —
+    backpressure: the caller waits for acks to free space instead of
+    growing the disk footprint without bound."""
+
+
+@dataclasses.dataclass
+class SpoolStats:
+    depth: int  # unacked records
+    bytes: int  # live segment bytes on disk
+    oldest_unacked_age_secs: float  # 0.0 when empty
+    acked_watermark: int
+    next_seqno: int
+
+
+@dataclasses.dataclass
+class _Segment:
+    path: str
+    first: int  # first seqno in the file
+    last: int  # last seqno written (first-1 when empty)
+    nbytes: int
+
+
+class SampleSpool:
+    """Append-only segment spool with a durable contiguous-ack watermark.
+
+    Durability contract (the whole point — see ConsumedLog): ``append``
+    returns only after the record is flushed AND fsynced, so the caller
+    may mark the prompt consumed knowing the trajectory can always be
+    replayed. The ack watermark file is written atomically (tmp+rename)
+    but NOT fsynced per ack: losing it merely replays extra records,
+    which the trainer's idempotent ingest absorbs — the safe direction.
+
+    Unacked payloads are also kept in memory (bounded by ``max_bytes``,
+    the same bound as the disk footprint) so the sender never re-reads
+    the segment files on the hot path; a restart reloads them from disk.
+
+    Thread-safe: the asyncio loop appends (via ``asyncio.to_thread``)
+    while the sender thread acks and reads pending records.
+    """
+
+    def __init__(self, directory: str, segment_bytes: int = 8 << 20,
+                 max_bytes: int = 256 << 20):
+        if segment_bytes <= 0 or max_bytes < segment_bytes:
+            raise ValueError(
+                f"spool needs 0 < segment_bytes ({segment_bytes}) <= "
+                f"max_bytes ({max_bytes})"
+            )
+        self.dir = directory
+        self.segment_bytes = segment_bytes
+        self.max_bytes = max_bytes
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
+        self._fh = None  # active segment file handle (append mode)
+        self._segments: List[_Segment] = []
+        self._recs: Dict[int, Tuple[float, bytes]] = {}  # seqno -> (ts, raw)
+        self._acked_above: set = set()  # acked but > watermark (gap acks)
+        self._watermark = self._read_watermark()
+        self._next = self._watermark + 1
+        self._bytes = 0
+        self._closed = False
+        self._recover()
+
+    # ---------------- recovery ----------------
+
+    @property
+    def _wm_path(self) -> str:
+        return os.path.join(self.dir, "acked")
+
+    def _read_watermark(self) -> int:
+        try:
+            with open(self._wm_path) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    def _write_watermark(self) -> None:
+        tmp = self._wm_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(self._watermark))
+        os.replace(tmp, self._wm_path)
+
+    def _recover(self) -> None:
+        """Scan existing segments: rebuild the unacked record map, repair
+        a torn tail (crash mid-append — the record never fully landed, so
+        it is dropped; by the spool-before-consumed ordering its prompt
+        was not yet consumed and re-trains once, the safe direction)."""
+        names = sorted(
+            n for n in os.listdir(self.dir)
+            if n.startswith("seg-") and n.endswith(".spool")
+        )
+        expected = None
+        for name in names:
+            path = os.path.join(self.dir, name)
+            raw = open(path, "rb").read()
+            off = 0
+            first = int(name[len("seg-"):-len(".spool")])
+            if expected is not None and first != expected:
+                logger.error(
+                    f"spool {self.dir}: segment {name} starts at {first}, "
+                    f"expected {expected} — dropping it and everything "
+                    f"after (mid-chain corruption)"
+                )
+                os.remove(path)
+                continue
+            seg = _Segment(path, first, first - 1, 0)
+            while off + _HDR_BYTES <= len(raw):
+                seqno, ts, length = _HDR.unpack_from(raw, off)
+                (crc,) = _CRC.unpack_from(raw, off + _HDR.size)
+                end = off + _HDR_BYTES + length
+                if end > len(raw):
+                    break  # torn payload
+                payload = raw[off + _HDR_BYTES:end]
+                if crc != zlib.crc32(raw[off:off + _HDR.size] + payload):
+                    break  # torn/corrupt record
+                if seqno != seg.last + 1:
+                    break  # sequence break: treat like corruption
+                seg.last = seqno
+                seg.nbytes += end - off
+                if seqno > self._watermark:
+                    self._recs[seqno] = (ts, payload)
+                off = end
+            if off < len(raw):
+                logger.warning(
+                    f"spool {self.dir}: truncating torn tail of {name} "
+                    f"at byte {off} (crash mid-append); the dropped "
+                    f"record was never marked consumed"
+                )
+                with open(path, "rb+") as f:
+                    f.truncate(off)
+            if seg.last < seg.first:  # nothing valid in the file
+                os.remove(path)
+                continue
+            self._segments.append(seg)
+            self._bytes += seg.nbytes
+            expected = seg.last + 1
+        if self._segments:
+            self._next = max(self._next, self._segments[-1].last + 1)
+        # Segments fully below the watermark survived a crash between
+        # the ack and the delete — drop them now.
+        self._gc_locked()
+
+    # ---------------- append ----------------
+
+    def append(self, payload: bytes, ts: Optional[float] = None) -> int:
+        """Durably append one record; returns its seqno. Raises
+        :class:`SpoolFull` when ``max_bytes`` would be exceeded."""
+        return self.append_framed(lambda seqno: payload, ts=ts)
+
+    def append_framed(self, frame: Callable[[int], bytes],
+                      ts: Optional[float] = None) -> int:
+        """Like ``append`` but the payload may embed its own seqno:
+        ``frame(seqno) -> bytes`` runs under the spool lock, so the
+        seqno order always matches the on-disk record order."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("spool is closed")
+            seqno = self._next
+            payload = frame(seqno)
+            size = _HDR_BYTES + len(payload)
+            if self._bytes + size > self.max_bytes:
+                raise SpoolFull(
+                    f"spool at {self._bytes}B (+{size}B > "
+                    f"{self.max_bytes}B cap): trainer acks are not "
+                    f"keeping up"
+                )
+            ts = time.time() if ts is None else ts
+            hdr20 = _HDR.pack(seqno, ts, len(payload))
+            rec = hdr20 + _CRC.pack(zlib.crc32(hdr20 + payload)) + payload
+            fh = self._active_segment(seqno)
+            fh.write(rec)
+            fh.flush()
+            os.fsync(fh.fileno())
+            self._segments[-1].last = seqno
+            self._segments[-1].nbytes += len(rec)
+            self._bytes += len(rec)
+            self._recs[seqno] = (ts, payload)
+            self._next = seqno + 1
+            return seqno
+
+    def _active_segment(self, next_seqno: int):
+        if self._fh is not None \
+                and self._segments[-1].nbytes >= self.segment_bytes:
+            self._fh.close()
+            self._fh = None
+        if self._fh is None:
+            # Always a fresh file (named by its first seqno): a restarted
+            # worker starts a new segment rather than appending to the
+            # recovered tail, keeping the name↔first-seqno invariant.
+            path = os.path.join(self.dir, f"seg-{next_seqno:016d}.spool")
+            self._fh = open(path, "ab")
+            if not self._segments or self._segments[-1].path != path:
+                self._segments.append(
+                    _Segment(path, next_seqno, next_seqno - 1, 0)
+                )
+        return self._fh
+
+    # ---------------- ack / read ----------------
+
+    def ack(self, seqnos: Sequence[int]) -> int:
+        """Mark records delivered-and-settled (trained or durably
+        dropped); returns how many were newly acked. Advances the
+        contiguous watermark and deletes fully-acked segment prefixes."""
+        with self._lock:
+            n_new = 0
+            for s in seqnos:
+                s = int(s)
+                if s <= self._watermark or s in self._acked_above \
+                        or s >= self._next:
+                    continue
+                self._acked_above.add(s)
+                self._recs.pop(s, None)
+                n_new += 1
+            advanced = False
+            while self._watermark + 1 in self._acked_above:
+                self._watermark += 1
+                self._acked_above.discard(self._watermark)
+                advanced = True
+            if advanced:
+                self._write_watermark()
+                self._gc_locked()
+            if n_new:
+                self._space.notify_all()
+            return n_new
+
+    def _gc_locked(self) -> None:
+        keep: List[_Segment] = []
+        for seg in self._segments:
+            if seg.last <= self._watermark:
+                if self._fh is not None and self._fh.name == seg.path:
+                    self._fh.close()
+                    self._fh = None
+                try:
+                    os.remove(seg.path)
+                except FileNotFoundError:
+                    pass
+                self._bytes -= seg.nbytes
+            else:
+                keep.append(seg)
+        self._segments = keep
+
+    def wait_for_space(self, timeout: float) -> bool:
+        """Block until an ack frees space (or timeout); used by the
+        submit path's backpressure loop."""
+        with self._space:
+            return self._space.wait(timeout)
+
+    def pending(self, after: int = 0) -> List[Tuple[int, float, bytes]]:
+        """Unacked records with seqno > ``after``, in seqno order."""
+        with self._lock:
+            return sorted(
+                (s, ts, raw) for s, (ts, raw) in self._recs.items()
+                if s > after
+            )
+
+    def unacked_seqnos(self) -> List[int]:
+        with self._lock:
+            return sorted(self._recs)
+
+    def stats(self) -> SpoolStats:
+        with self._lock:
+            oldest = min((ts for ts, _ in self._recs.values()), default=None)
+            return SpoolStats(
+                depth=len(self._recs),
+                bytes=self._bytes,
+                oldest_unacked_age_secs=(
+                    max(0.0, time.time() - oldest) if oldest else 0.0
+                ),
+                acked_watermark=self._watermark,
+                next_seqno=self._next,
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            self._space.notify_all()
+
+
+class SpoolSender(threading.Thread):
+    """Background drain of a :class:`SampleSpool` to the trainer.
+
+    Owns the worker's data-plane sockets once started: the asyncio loop
+    only ever calls :meth:`submit` (durable enqueue, via
+    ``asyncio.to_thread``) — ZMQ I/O, ack processing, the resend timer,
+    and the spool gauges all live on this thread, so a dead/slow trainer
+    degrades into spool growth + backpressure instead of wedging the
+    event loop inside a blocking ``send``.
+
+    Ack loss is self-healing: any record unacked for
+    ``resend_timeout_secs`` after its last send is pushed again with the
+    replay flag set; the trainer's :class:`SpoolIngest` dedups and
+    re-acks. Records found in the spool at startup (a respawned worker)
+    are replays by definition and are re-sent the same way.
+    """
+
+    def __init__(self, spool: SampleSpool, pusher, ack_puller,
+                 worker_index: int, resend_timeout_secs: float = 30.0,
+                 poll_secs: float = 0.05):
+        super().__init__(name=f"spool-sender-{worker_index}", daemon=True)
+        self.spool = spool
+        self.pusher = pusher
+        self.ack_puller = ack_puller
+        self.worker_index = worker_index
+        self.resend_timeout_secs = resend_timeout_secs
+        self.poll_secs = poll_secs
+        self._wake = threading.Event()
+        self._closing = threading.Event()
+        self._last_sent = spool.stats().acked_watermark
+        self._sent_at: Dict[int, float] = {}
+        # Everything already in the spool predates this incarnation:
+        # crash-replay records, flagged so the trainer's staleness gate
+        # sees them (fresh sends just passed the manager's gate).
+        self._replay = set(spool.unacked_seqnos())
+        self._gauges_at = 0.0
+
+    # ---- producer side (asyncio loop, via to_thread) ----
+
+    def submit(self, obj: Dict[str, Any]) -> int:
+        """Durably spool one trajectory payload; returns its seqno. The
+        active telemetry trace is captured here (contextvars propagate
+        through ``asyncio.to_thread``), exactly like the direct-push
+        path. Blocks under backpressure until acks free spool space."""
+        obj = telemetry.inject_payload(obj)
+
+        def frame(seqno: int) -> bytes:
+            from areal_tpu.system.streams import _pack
+
+            obj[SPOOL_KEY] = {"w": self.worker_index, "seq": seqno}
+            return _pack(obj)
+
+        while True:
+            try:
+                seqno = self.spool.append_framed(frame)
+                break
+            except SpoolFull:
+                telemetry.inc("spool/backpressure_waits")
+                if self._closing.is_set():
+                    raise
+                self.spool.wait_for_space(0.5)
+        telemetry.inc("spool/appended")
+        self._wake.set()
+        return seqno
+
+    # ---- sender thread ----
+
+    def _drain_acks(self) -> None:
+        while True:
+            try:
+                msg = self.ack_puller.pull(timeout_ms=0)
+            except Exception:  # noqa: BLE001 — socket closed during exit
+                return
+            if msg is None:
+                return
+            seqnos = msg.get("seqnos") if isinstance(msg, dict) else None
+            if not seqnos:
+                continue
+            n = self.spool.ack(seqnos)
+            for s in seqnos:
+                self._sent_at.pop(int(s), None)
+            if n:
+                telemetry.inc("spool/acked", n)
+
+    def _send_raw(self, seqno: int, raw: bytes, replay: bool) -> bool:
+        """One non-blocking send attempt; False = HWM, retry later."""
+        if replay:
+            # Re-sends re-frame with the replay flag so the trainer's
+            # staleness gate examines them; first sends go out exactly
+            # as spooled (zero repack on the hot path).
+            from areal_tpu.system.streams import _pack, _unpack
+
+            obj = _unpack(raw)
+            meta = obj.get(SPOOL_KEY)
+            if isinstance(meta, dict):
+                meta["r"] = 1
+            raw = _pack(obj)
+        try:
+            self.pusher.push_packed(raw, block_secs=0.0)
+        except Exception:  # noqa: BLE001 — zmq.Again / transient
+            return False
+        self._sent_at[seqno] = time.monotonic()
+        return True
+
+    def _pump(self) -> None:
+        self._drain_acks()
+        # First sends (and restart replays) in seqno order.
+        for seqno, _ts, raw in self.spool.pending(after=self._last_sent):
+            replay = seqno in self._replay
+            if not self._send_raw(seqno, raw, replay):
+                return  # blocked at HWM; retry next tick
+            if replay:
+                telemetry.inc("spool/replayed")
+                self._replay.discard(seqno)
+            self._last_sent = max(self._last_sent, seqno)
+        # Resend timer: an unacked record the trainer never settled
+        # (death between pull and train, or a lost ack).
+        now = time.monotonic()
+        for seqno, _ts, raw in self.spool.pending(after=0):
+            if seqno > self._last_sent:
+                continue
+            at = self._sent_at.get(seqno)
+            if at is not None and now - at < self.resend_timeout_secs:
+                continue
+            if at is None and seqno in self._replay:
+                continue  # still queued for its first (replay) send
+            if not self._send_raw(seqno, raw, replay=True):
+                return
+            telemetry.inc("spool/resent")
+
+    def _publish_gauges(self) -> None:
+        now = time.monotonic()
+        if now - self._gauges_at < 1.0:
+            return
+        self._gauges_at = now
+        st = self.spool.stats()
+        telemetry.set_gauge("spool/depth", float(st.depth))
+        telemetry.set_gauge("spool/bytes", float(st.bytes))
+        telemetry.set_gauge(
+            "spool/oldest_unacked_age_secs", st.oldest_unacked_age_secs
+        )
+
+    def run(self) -> None:
+        while not self._closing.is_set():
+            try:
+                self._pump()
+                self._publish_gauges()
+            except Exception as e:  # noqa: BLE001 — sender must survive
+                logger.warning(f"spool sender pump failed ({e}); retrying")
+                time.sleep(0.2)
+            self._wake.wait(self.poll_secs)
+            self._wake.clear()
+
+    def close(self, drain_secs: float = 5.0) -> None:
+        """Stop the sender, first giving in-flight acks ``drain_secs``
+        to settle (a clean exit with an empty spool leaves nothing to
+        replay next incarnation)."""
+        deadline = time.monotonic() + max(drain_secs, 0.0)
+        while self.spool.stats().depth > 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        self._closing.set()
+        self._wake.set()
+        self.join(timeout=5.0)
+        self._publish_gauges_final()
+        self.spool.close()
+
+    def _publish_gauges_final(self) -> None:
+        self._gauges_at = 0.0
+        try:
+            self._publish_gauges()
+        except Exception:  # noqa: BLE001 — registry already shut down
+            pass
+
+
+class SpoolIngest:
+    """Trainer-side idempotent ingest bookkeeping (rank 0 only).
+
+    ``observe`` classifies each arriving spooled sample; the pull loop
+    acts on the verdict:
+
+    - ``("ingest", None)`` — first sighting: enqueue for training; the
+      ack is emitted later, when the master's freed-id forwarding (the
+      "clear" RPC after the optimizer step commits, or a buffer-level
+      durable drop) names the sample in :meth:`pop_settled`.
+    - ``("duplicate", None)`` — the original is still in the pipeline:
+      drop the copy silently; its ack rides the original's settlement.
+    - ``("duplicate", (w, seq))`` — already settled here (the ack was
+      lost in flight): re-ack immediately so the worker stops resending.
+    - ``("stale", (w, seq))`` — a replay that fell behind the staleness
+      bound while the trainer was down: durably dropped — count it and
+      ack it (the paper's gate bounds off-policyness; replaying
+      arbitrarily old trajectories would silently violate it).
+
+    The ingested-id set grows for the life of the process (a few dozen
+    bytes per trajectory — the same order as the ConsumedLog it
+    mirrors); a trainer restart clears it, which is exactly when
+    replayed ids must re-ingest.
+    """
+
+    def __init__(self, staleness_limit: int = 8):
+        self.staleness_limit = staleness_limit
+        self._lock = threading.Lock()
+        self._ids: set = set()
+        self._pending: Dict[Any, Tuple[int, int]] = {}
+
+    def observe(self, sample_id: Any, meta: Dict[str, Any],
+                cur_version: float,
+                sample_version: Optional[float]) -> Tuple[
+                    str, Optional[Tuple[int, int]]]:
+        w, seq = int(meta["w"]), int(meta["seq"])
+        with self._lock:
+            if sample_id in self._ids:
+                if sample_id in self._pending:
+                    return "duplicate", None
+                return "duplicate", (w, seq)
+            if meta.get("r") and self.staleness_limit >= 0 \
+                    and sample_version is not None \
+                    and cur_version - sample_version > self.staleness_limit:
+                # Remember the id: later resends of the same dropped
+                # record hit the settled-duplicate path and re-ack.
+                self._ids.add(sample_id)
+                return "stale", (w, seq)
+            self._ids.add(sample_id)
+            self._pending[sample_id] = (w, seq)
+            return "ingest", None
+
+    def pop_settled(self, sample_ids: Sequence[Any]) -> Dict[int, List[int]]:
+        """Sample ids the master reported freed (trained or durably
+        dropped) → ``{worker_index: [seqnos]}`` to ack."""
+        out: Dict[int, List[int]] = {}
+        with self._lock:
+            for sid in sample_ids:
+                ws = self._pending.pop(sid, None)
+                if ws is not None:
+                    out.setdefault(ws[0], []).append(ws[1])
+        return out

@@ -141,6 +141,15 @@ METRIC_CATALOG = frozenset({
     "supervisor/restarts", "supervisor/deaths", "supervisor/draining",
     "reward/requests", "reward/timeouts", "reward/errors",
     "telemetry/spans_dropped",
+    # durable sample spool (system/sample_spool.py): per-rollout-worker
+    # depth/bytes/age gauges + delivery counters, the trainer's
+    # dedup/stale-drop counters, and the stream/buffer degradation
+    # counters the at-least-once path leans on.
+    "spool/depth", "spool/bytes", "spool/oldest_unacked_age_secs",
+    "spool/appended", "spool/acked", "spool/resent", "spool/replayed",
+    "spool/backpressure_waits", "spool/replay_stale_dropped",
+    "spool/duplicate_dropped", "buffer/duplicate_dropped",
+    "stream/push_blocked",
 })
 
 _DUR_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*(ms|s|m|h)?\s*$")
@@ -252,6 +261,20 @@ DEFAULT_RULES: Tuple[Dict[str, Any], ...] = (
                     "far off its rolling baseline: chips went idle — "
                     "check the per-state split (perf_probe goodput) for "
                     "which side starved"},
+)
+
+
+# Armed only when durability.enabled (rules_from_config): an absence
+# rule fires even for a never-seen metric, so shipping this in the
+# always-on pack would false-fire on every non-durable run.
+DURABILITY_RULES: Tuple[Dict[str, Any], ...] = (
+    {"id": "sample_loss", "metric": "spool/acked", "kind": "absence",
+     "for": 1800, "cooldown": 1800, "severity": "critical",
+     "description": "no spool ack in 30 minutes: trajectories are being "
+                    "generated but never settle at the trainer — the "
+                    "at-least-once loop is broken somewhere between push, "
+                    "train, and ack (perf_probe spool-status; "
+                    "docs/operations.md §Did we lose samples?)"},
 )
 
 
@@ -372,13 +395,16 @@ def parse_rules(raw_rules: Sequence[Dict[str, Any]],
     return rules
 
 
-def rules_from_config(cfg) -> List[Rule]:
+def rules_from_config(cfg, durability_enabled: bool = False) -> List[Rule]:
     """``SentinelConfig`` → parsed rule list: the default pack (unless
-    ``default_rules=false``) plus the operator's ``rules`` entries. This
+    ``default_rules=false``), the durability pack when the durable
+    sample spool is armed, plus the operator's ``rules`` entries. This
     is the function ``validate_config`` front-runs at parse time."""
     raw: List[Dict[str, Any]] = []
     if getattr(cfg, "default_rules", True):
         raw.extend(dict(r) for r in DEFAULT_RULES)
+        if durability_enabled:
+            raw.extend(dict(r) for r in DURABILITY_RULES)
     raw.extend(getattr(cfg, "rules", []) or [])
     return parse_rules(raw)
 

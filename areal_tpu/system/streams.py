@@ -52,6 +52,10 @@ class Payload:
     post_hooks: List[Dict] = dataclasses.field(default_factory=list)
     output: Any = None
     exception: Optional[str] = None
+    # Reply-completion flag, set by WorkerRequestServer.reply: a reply is
+    # done because the worker SAID so, not because output happens to be
+    # non-None — a legitimate None-output reply must not wedge gather.
+    done: bool = False
 
 
 class MasterRequestStream:
@@ -113,7 +117,13 @@ class MasterRequestStream:
         while len(out) < len(request_ids):
             for rid in request_ids:
                 p = self._pending.get(rid)
-                if p is not None and (p.output is not None or p.exception):
+                # getattr + output-sniffing fallback: tolerate a reply
+                # pickled by a pre-``done``-flag worker during a rolling
+                # restart (the request Payload parked here by post() has
+                # done=False and never false-completes).
+                if p is not None and (getattr(p, "done", False)
+                                      or p.output is not None
+                                      or p.exception):
                     out[rid] = self._pending.pop(rid)
             if len(out) >= len(request_ids):
                 break
@@ -171,6 +181,7 @@ class WorkerRequestServer:
 
     def reply(self, p: Payload) -> None:
         ident = self._peer_of.pop(p.request_id)
+        p.done = True
         self._sock.send_multipart([ident, pickle.dumps(p)])
 
     def close(self):
@@ -253,10 +264,19 @@ class ZmqPuller:
 
 class ZmqPusher:
     """Discovers the puller via name_resolve (reference
-    NameResolvingZmqPusher:141)."""
+    NameResolvingZmqPusher:141).
+
+    Sends are NON-wedging: a slow/dead puller used to freeze the
+    caller's thread forever inside a blocking ``send`` at the HWM — on a
+    rollout worker that wedged the whole asyncio loop. Every send now
+    uses ``zmq.NOBLOCK`` with a bounded retry budget (``block_secs``)
+    and counts each blocked attempt in ``stream/push_blocked``, so
+    backpressure degrades visibly (a climbing counter, then a loud
+    ``zmq.Again``) instead of silently."""
 
     def __init__(self, experiment: str, trial: str, puller: str,
-                 capacity: int = 16384, timeout: float = 300.0):
+                 capacity: int = 16384, timeout: float = 300.0,
+                 block_secs: float = 120.0):
         addr = name_resolve.wait(
             push_pull_addr_key(experiment, trial, puller), timeout=timeout
         )
@@ -264,6 +284,7 @@ class ZmqPusher:
         self._sock = self._ctx.socket(zmq.PUSH)
         self._sock.setsockopt(zmq.SNDHWM, capacity)
         self._sock.connect(addr)
+        self.block_secs = block_secs
 
     def push(self, obj: Any) -> None:
         # Sample-lineage tracing (docs/observability.md): dict payloads
@@ -273,7 +294,24 @@ class ZmqPusher:
         # survives buffer/store hops. With telemetry disabled (or no
         # active trace) inject_payload returns the object untouched:
         # the wire bytes are identical to the pre-tracing format.
-        self._sock.send(_pack(telemetry.inject_payload(obj)))
+        self.push_packed(_pack(telemetry.inject_payload(obj)))
+
+    def push_packed(self, raw: bytes,
+                    block_secs: Optional[float] = None) -> None:
+        """Send pre-packed bytes (the durable spool sender re-sends the
+        exact bytes it spooled). Raises ``zmq.Again`` once the retry
+        budget is exhausted."""
+        budget = self.block_secs if block_secs is None else block_secs
+        deadline = time.monotonic() + budget
+        while True:
+            try:
+                self._sock.send(raw, zmq.NOBLOCK)
+                return
+            except zmq.Again:
+                telemetry.inc("stream/push_blocked")
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     def close(self):
         self._sock.close(linger=0)

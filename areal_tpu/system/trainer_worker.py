@@ -39,6 +39,7 @@ from areal_tpu.api.model import (
     make_interface,
 )
 from areal_tpu.api.train_config import (
+    DurabilityConfig,
     GoodputConfig,
     RewardServiceConfig,
     TelemetryConfig,
@@ -46,7 +47,17 @@ from areal_tpu.api.train_config import (
 )
 from areal_tpu.base import logging, name_resolve, names, telemetry
 from areal_tpu.system import goodput as goodput_mod
-from areal_tpu.system.streams import Payload, WorkerRequestServer, ZmqPuller
+from areal_tpu.system.sample_spool import (
+    SPOOL_KEY,
+    SpoolIngest,
+    ack_channel_name,
+)
+from areal_tpu.system.streams import (
+    Payload,
+    WorkerRequestServer,
+    ZmqPuller,
+    ZmqPusher,
+)
 
 logger = logging.getLogger("system.trainer")
 
@@ -110,6 +121,14 @@ class TrainerWorkerConfig:
     reward_service: RewardServiceConfig = dataclasses.field(
         default_factory=RewardServiceConfig
     )
+    # Durable sample delivery (system/sample_spool.py): knobs for the
+    # trainer side of the at-least-once loop — the replay staleness gate
+    # and ack-push budgets. Ingest/ack machinery itself is keyed off
+    # arriving ``_spool`` metadata, so a worker/trainer config mismatch
+    # still settles instead of resending forever.
+    durability: DurabilityConfig = dataclasses.field(
+        default_factory=DurabilityConfig
+    )
     # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
     # one per host — join one jax.distributed program; rank 0 owns every
     # control-plane socket and broadcasts (request, data) to the others,
@@ -140,6 +159,13 @@ class TrainerWorker:
         self._puller: Optional[ZmqPuller] = None
         self._pull_q: "queue.Queue[SequenceSample]" = queue.Queue()
         self._pull_thread = None
+        # Durable-delivery bookkeeping (rank 0, stream mode): idempotent
+        # ingest + the per-worker ack pushers (created lazily on first
+        # ack for a worker index). _ack_lock serializes the pull thread
+        # (stale drops / re-acks) against the serve thread ("clear").
+        self._ingest: Optional[SpoolIngest] = None
+        self._ack_pushers: Dict[int, ZmqPusher] = {}
+        self._ack_lock = threading.Lock()
         self._model_factory = model_factory or self._default_model_factory
         self._exiting = False
         self._weight_publishers: Dict[str, Any] = {}  # role -> publisher
@@ -227,6 +253,9 @@ class TrainerWorker:
             self._reshuffle()
         if cfg.stream_dataset and self._rank0:
             self._puller = ZmqPuller(cfg.experiment, cfg.trial, cfg.handler)
+            self._ingest = SpoolIngest(
+                staleness_limit=cfg.durability.replay_staleness_limit
+            )
             self._pull_thread = threading.Thread(
                 target=self._pull_loop, daemon=True
             )
@@ -285,6 +314,13 @@ class TrainerWorker:
         while not self._exiting:
             obj = self._puller.pull(timeout_ms=200)
             if obj is not None:
+                # Optional durable-spool framing (system/sample_spool.py):
+                # popped like the trace key below, absent on non-durable
+                # pushes (bit-identical legacy path).
+                spool_meta = (
+                    obj.pop(SPOOL_KEY, None) if isinstance(obj, dict)
+                    else None
+                )
                 # Optional sample-lineage context pushed by the rollout
                 # worker (streams.ZmqPusher): keep it in the sample's
                 # METADATA — it survives the master's metadata buffer and
@@ -294,7 +330,75 @@ class TrainerWorker:
                 s = SequenceSample.from_json_compatible(obj)
                 if trace is not None:
                     s.metadata["_trace"] = [trace.as_dict()]
+                if spool_meta is not None and self._ingest is not None \
+                        and not self._ingest_spooled(s, spool_meta):
+                    continue
                 self._pull_q.put(s)
+
+    def _ingest_spooled(self, s: SequenceSample, meta: Dict) -> bool:
+        """At-least-once ingest decision; False = drop (do not enqueue).
+
+        Duplicates are a NORMAL event here (a resend racing its own ack,
+        or a replay of an already-settled record after the ack was lost)
+        — dropped idempotently, re-acked when already settled. Replays
+        re-enter the staleness gate: the paper's bounded-off-policyness
+        contract must hold across a trainer outage too, so a replay
+        whose version lag exceeds the bound is durably dropped (counted
+        + acked — a drop the worker knows about is not sample loss)."""
+        sid = s.ids[0]
+        cur = max(
+            (m.version.global_step for m in self.models.values()),
+            default=0,
+        )
+        sample_ver = None
+        if "version_end" in s.data:
+            sample_ver = float(
+                np.asarray(s.data["version_end"]).reshape(-1)[0]
+            )
+        action, ackp = self._ingest.observe(sid, meta, cur, sample_ver)
+        if action == "duplicate":
+            telemetry.inc("spool/duplicate_dropped")
+            if ackp is not None:
+                self._send_acks({ackp[0]: [ackp[1]]})
+            return False
+        if action == "stale":
+            telemetry.inc("spool/replay_stale_dropped")
+            self._send_acks({ackp[0]: [ackp[1]]})
+            return False
+        return True
+
+    def _send_acks(self, by_worker: Dict[int, List[int]]) -> None:
+        """Push settled seqnos back to each worker's ack channel. Best
+        effort by design: a lost ack is recovered by the worker's resend
+        timer + this side's settled-duplicate re-ack, so failures are
+        logged and dropped rather than retried here."""
+        if not by_worker:
+            return
+        with self._ack_lock:
+            for w, seqnos in by_worker.items():
+                try:
+                    pusher = self._ack_pushers.get(w)
+                    if pusher is None:
+                        pusher = ZmqPusher(
+                            self.cfg.experiment, self.cfg.trial,
+                            ack_channel_name(w), timeout=5.0,
+                            block_secs=1.0,
+                        )
+                        self._ack_pushers[w] = pusher
+                    pusher.push({"seqnos": [int(s) for s in seqnos]})
+                except Exception as e:  # noqa: BLE001 — worker down/respawning
+                    logger.warning(
+                        f"ack push to rollout worker {w} failed ({e}); "
+                        f"its resend timer will recover"
+                    )
+                    # Drop the pusher: a respawned worker binds a fresh
+                    # address under the same key.
+                    stale = self._ack_pushers.pop(w, None)
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except Exception:  # noqa: BLE001
+                            pass
 
     # ---------------- handlers ----------------
 
@@ -801,8 +905,16 @@ class TrainerWorker:
         return info
 
     def _handle_clear(self, p: Payload) -> Any:
-        for sid in p.data or []:
+        sids = list(p.data or [])
+        for sid in sids:
             self.store.pop(sid, None)
+        if self._ingest is not None and sids:
+            # Freed ids are SETTLED samples (fully consumed by every MFC
+            # after the optimizer step committed, or durably dropped by
+            # the master's buffer) — the ack point of the at-least-once
+            # delivery loop. Rank 0 only: followers replay "clear" for
+            # the store pop, but _ingest exists only where the puller is.
+            self._send_acks(self._ingest.pop_settled(sids))
         return {"n_stored": len(self.store)}
 
     # ---------------- checkpoint / restore ----------------
@@ -989,8 +1101,15 @@ class TrainerWorker:
                 self._follow_once()
         if self._server:
             self._server.close()
+        if self._pull_thread is not None:
+            # _exiting is set; the loop exits within one 200ms poll. Join
+            # before close — destroying the socket under a live poll
+            # raises ENOTSOCK in the thread.
+            self._pull_thread.join(timeout=2.0)
         if self._puller:
             self._puller.close()
+        for pusher in self._ack_pushers.values():
+            pusher.close()
         for pub in self._weight_publishers.values():
             pub.close()
         self._ledger.flush()

@@ -244,6 +244,52 @@ def main():
     weight_sync_device_s = time.perf_counter() - t0
     rsh.clear_publication("bench", "b0", "actor")
 
+    # Durable-spool overhead (host-only, no sockets): the per-trajectory
+    # cost the rollout worker pays when durability is on — msgpack-frame
+    # each bench trajectory the way ZmqPusher wires it, append (CRC +
+    # fsync) to a SampleSpool, then ack the batch (watermark write + GC).
+    # Reported per record so the number is workload-size independent;
+    # gated by tools/bench_compare.py (docs/fault_tolerance.md §Data
+    # durability).
+    import shutil
+    import tempfile
+
+    from areal_tpu.system import streams
+    from areal_tpu.system.sample_spool import SampleSpool
+
+    frames = []
+    off = 0
+    for i, (p, g) in enumerate(zip(plens, glens)):
+        ln = int(p + g)
+        single = SequenceSample.from_default(
+            ids=[f"b{i}"],
+            data={
+                "packed_input_ids": toks[off:off + ln],
+                "prompt_mask": np.concatenate(
+                    [np.ones(p, np.int32), np.zeros(g, np.int32)]),
+                "packed_logprobs": lps[i],
+                "rewards": rng.rand(1).astype(np.float32),
+                "seq_no_eos_mask": np.zeros(1, np.float32),
+            },
+            seqlens=[ln],
+        )
+        frames.append(streams._pack(single.as_json_compatible()))
+        off += ln
+    spool_dir = tempfile.mkdtemp(prefix="bench_spool_")
+    try:
+        spool = SampleSpool(spool_dir)
+        t0 = time.perf_counter()
+        seqnos = [spool.append(raw) for raw in frames]
+        spool_append_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        spool.ack(seqnos)
+        spool_ack_s = time.perf_counter() - t0
+        spool.close()
+    finally:
+        shutil.rmtree(spool_dir, ignore_errors=True)
+    spool_append_ms = spool_append_s / len(frames) * 1e3
+    spool_ack_ms = spool_ack_s / len(frames) * 1e3
+
     # Roofline context over the bf16 peak of one chip. The 6·N·T train
     # FLOPs estimate and the per-generation peak table live in
     # base/monitor.py — ONE accounting shared with the live trainer's
@@ -266,6 +312,8 @@ def main():
         "weight_sync_io_s": round(weight_sync_io_s, 3),
         "weight_sync_transport_s": round(weight_sync_transport_s, 3),
         "weight_sync_device_s": round(weight_sync_device_s, 3),
+        "spool_append_ms": round(spool_append_ms, 3),
+        "spool_ack_ms": round(spool_ack_ms, 3),
         # METHOD CHANGE vs r6: the device transport (on-device reshard
         # publish + digest-gated consume) is measured ALONGSIDE the
         # streamed path — weight_sync_latency_s still names the streamed

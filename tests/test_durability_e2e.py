@@ -1,0 +1,488 @@
+"""Chaos e2e for the durable trajectory spool (ISSUE 17 acceptance).
+
+SIGKILL the trainer mid-run of the async-PPO loop with durability ON,
+then relaunch the experiment from the recover checkpoint — the PR 9
+supervision semantics for the stateful domain (supervisor.py: a trainer
+death escalates as SupervisorEscalation, which ``recover_mode=auto``
+converts into a whole-experiment relaunch; every worker here is spawned
+the way the supervisor would respawn it). The run must complete with
+
+ - every trajectory that was spooled-but-unacked at kill time REPLAYED
+   from disk (``spool/replayed`` equals the on-disk unacked count at the
+   phase boundary) instead of regenerated,
+ - zero regeneration of consumed prompts (the ConsumedLog skiplist:
+   no uid ever re-enters generation — pinned by duplicate-free consumed
+   logs whose phase-1 prefix is preserved),
+ - sample conservation at drain: on each worker,
+   acked(watermark) + still-on-disk == appended(next_seqno-1) — nothing
+   vanished without being trained or durably dropped,
+ - the live merged Prometheus scrape carrying the spool gauges from both
+   rollout workers.
+
+Heavy (9 spawned processes across two phases) → slow-marked; the fast
+per-component coverage lives in tests/test_sample_spool.py.
+"""
+
+import multiprocessing as mp
+import os
+import shutil
+import signal
+import time
+import urllib.request
+
+import pytest
+
+from areal_tpu.base import name_resolve, names, recover
+from areal_tpu.base.testing import MockTokenizer, make_math_jsonl
+
+EXP, TRIAL = "durchaos", "t0"
+TINY = {"vocab_size": 258, "seed": 0}
+TEL = {"enabled": True, "flush_interval_secs": 0.3}
+STEPS = 8  # total steps across both incarnations
+BATCH = 8
+
+
+def _tel():
+    from areal_tpu.api.train_config import TelemetryConfig
+
+    return TelemetryConfig(**TEL)
+
+
+def _durability():
+    from areal_tpu.api.train_config import DurabilityConfig
+
+    # Fast resend so a lost ack recovers within the test budget; the
+    # staleness gate is effectively open (replays across the restart must
+    # train, not drop, for the conservation assertions to be exact).
+    return DurabilityConfig(
+        enabled=True, resend_timeout_secs=2.0,
+        replay_staleness_limit=100000, drain_timeout_secs=1.0,
+    )
+
+
+def _gen_fleet_main(nr_root, realloc_dir):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    import asyncio
+
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.system.generation_server import (
+        GenerationServer,
+        GenerationServerConfig,
+    )
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerConfig,
+    )
+
+    async def main():
+        kw = dict(TINY)
+        seed = kw.pop("seed", 0)
+        cfg = tiny_config(**kw)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(seed))
+        server = GenerationServer(
+            GenerationServerConfig(
+                experiment=EXP, trial=TRIAL, chunk_tokens=4,
+                prompt_bucket=16, batch_window_ms=2, telemetry=_tel(),
+            ),
+            cfg, params,
+        )
+        await server.start()
+        mgr = GserverManager(GserverManagerConfig(
+            experiment=EXP, trial=TRIAL, n_servers=1,
+            # Tight staleness gate: the sample bank the workers can run
+            # ahead during the first (compile-heavy) step stays below
+            # STEPS*BATCH, so the phase-1 master CANNOT finish before the
+            # kill lands — the SIGKILL is guaranteed to be mid-run.
+            train_batch_size=BATCH, max_head_offpolicyness=2,
+            realloc_dir=realloc_dir, weight_poll_secs=0.2, telemetry=_tel(),
+        ))
+        await mgr.start()
+        while True:  # serves until the test terminates the process
+            await asyncio.sleep(1.0)
+
+    asyncio.run(main())
+
+
+def _rollout_main(nr_root, data_path, recover_dir, idx):
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    from areal_tpu.api.model import GenerationHyperparameters
+    from areal_tpu.system.rollout_worker import (
+        RolloutWorker,
+        RolloutWorkerConfig,
+    )
+
+    RolloutWorker(RolloutWorkerConfig(
+        experiment=EXP, trial=TRIAL, worker_index=idx, n_workers=2,
+        dataset_path=data_path,
+        gconfig=GenerationHyperparameters(max_new_tokens=8),
+        group_size=2, chunk_tokens=4, max_concurrent=3,
+        tokenizer=MockTokenizer(), max_rollouts=None, seed=1 + idx,
+        recover_dir=recover_dir, telemetry=_tel(),
+        durability=_durability(),
+    )).run()
+
+
+def _trainer_main(nr_root, realloc_dir):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    import areal_tpu.algorithms.ppo  # noqa: F401
+    import areal_tpu.backend.jax_train  # noqa: F401
+    from areal_tpu.algorithms.ppo import PPOHyperparameters
+    from areal_tpu.api.model import FinetuneSpec, GenerationHyperparameters
+    from areal_tpu.backend.jax_train import OptimizerConfig
+    from areal_tpu.system.trainer_worker import (
+        MFCRuntimeConfig,
+        ModelRoleConfig,
+        TrainerWorker,
+        TrainerWorkerConfig,
+    )
+
+    hp = PPOHyperparameters(
+        gen=GenerationHyperparameters(max_new_tokens=8),
+        ppo_n_minibatches=2, group_size=2, kl_ctl=0.05,
+        disable_value=True, group_adv_norm=False, adv_norm=True,
+        use_decoupled_loss=True, behav_imp_weight_cap=10.0,
+    )
+    backend_args = {
+        "compute_dtype": "float32", "length_bucket": 16, "rows_bucket": 2,
+        "seqs_bucket": 4,
+        "optimizer": OptimizerConfig(lr=1e-3, lr_scheduler_type="constant",
+                                     warmup_steps_proportion=0.0),
+    }
+    TrainerWorker(TrainerWorkerConfig(
+        experiment=EXP, trial=TRIAL, handler="trainer",
+        models={
+            "actor": ModelRoleConfig(init={"tiny": TINY},
+                                     backend_args=backend_args),
+            "ref": ModelRoleConfig(init={"tiny": TINY},
+                                   backend_args=backend_args, train=False),
+        },
+        mfcs={
+            "ref_inf": MFCRuntimeConfig(interface="ref_logprob",
+                                        model_name="ref"),
+            "actor_inf": MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor"),
+            "actor_train": MFCRuntimeConfig(
+                interface="ppo_actor", interface_args={"hp": hp},
+                model_name="actor"),
+        },
+        batch_size=BATCH,
+        ft_spec=FinetuneSpec(1, 64, BATCH),
+        tokenizer=MockTokenizer(),
+        stream_dataset=True,
+        realloc_dir=realloc_dir,
+        telemetry=_tel(),
+        durability=_durability(),
+    )).run()
+
+
+def _master_main(nr_root, recover_dir, jsonl_path, agg_port, do_recover):
+    from areal_tpu.base import name_resolve as nr
+
+    nr.DEFAULT_REPO = nr.NfsNameRecordRepo(nr_root)
+    import dataclasses as dc
+
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.api.dfg import (
+        MFCDef,
+        MFCInterfaceType,
+        ModelInterfaceAbstraction,
+        WeightUpdateHook,
+        build_graph,
+    )
+    from areal_tpu.system.master_worker import (
+        ExperimentSaveEvalControl,
+        MasterWorker,
+        MasterWorkerConfig,
+    )
+
+    mfcs = [
+        MFCDef(
+            name="ref_inf", model_name="ref",
+            interface_type=MFCInterfaceType.INFERENCE,
+            interface_impl=ModelInterfaceAbstraction("ref_logprob"),
+            input_keys=("packed_input_ids",),
+            output_keys=("packed_ref_logprobs",),
+            n_seqs=BATCH, mb_spec=MicroBatchSpec(max_tokens_per_mb=512),
+        ),
+        MFCDef(
+            name="actor_inf", model_name="actor",
+            interface_type=MFCInterfaceType.INFERENCE,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            input_keys=("packed_input_ids",),
+            output_keys=("prox_logprobs",),
+            n_seqs=BATCH, mb_spec=MicroBatchSpec(max_tokens_per_mb=512),
+        ),
+        MFCDef(
+            name="actor_train", model_name="actor",
+            interface_type=MFCInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            input_keys=("packed_input_ids", "prompt_mask", "packed_logprobs",
+                        "rewards", "packed_ref_logprobs", "prox_logprobs",
+                        "seq_no_eos_mask"),
+            n_seqs=BATCH, mb_spec=MicroBatchSpec(max_tokens_per_mb=512),
+            post_hooks=[WeightUpdateHook(role="actor")],
+        ),
+    ]
+    MasterWorker(
+        MasterWorkerConfig(
+            experiment=EXP, trial=TRIAL, train_batch_size=BATCH,
+            exp_ctrl=ExperimentSaveEvalControl(
+                total_train_epochs=10**6, benchmark_steps=STEPS,
+                ckpt_freq_steps=1,
+            ),
+            telemetry=dc.replace(_tel(), jsonl_path=jsonl_path,
+                                 http_port=agg_port),
+            durability=_durability(),
+            recover_dir=recover_dir, recover=do_recover,
+        ),
+        build_graph(mfcs),
+    ).run()
+
+
+def _spool_snapshot(recover_dir, tmp_path, tag):
+    """Per-worker (pending_count, watermark, next_seqno) read from a COPY
+    of the spool directory — opening a live spool would run recovery
+    (torn-tail truncation) against files a worker is still writing."""
+    from areal_tpu.system.sample_spool import SampleSpool
+
+    out = {}
+    for w in (0, 1):
+        src = os.path.join(recover_dir, f"spool_{w}")
+        if not os.path.isdir(src):
+            out[w] = (0, 0, 1)
+            continue
+        dst = str(tmp_path / f"snap_{tag}_{w}")
+        shutil.rmtree(dst, ignore_errors=True)
+        shutil.copytree(src, dst)
+        sp = SampleSpool(dst)
+        st = sp.stats()
+        out[w] = (st.depth, st.acked_watermark, st.next_seqno)
+        sp.close()
+    return out
+
+
+def _consumed_uids(recover_dir, w):
+    path = os.path.join(recover_dir, f"rollout_consumed_{w}.log")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.durability
+@pytest.mark.timeout(900)
+def test_trainer_sigkill_replays_spool_no_sample_loss(tmp_path):
+    nr_root = str(tmp_path / "nr")
+    data_path = str(tmp_path / "math.jsonl")
+    realloc_dir = str(tmp_path / "realloc")
+    recover_dir = str(tmp_path / "recover")
+    jsonl_path = str(tmp_path / "telemetry.jsonl")
+    make_math_jsonl(data_path, n=16)
+    name_resolve.DEFAULT_REPO = name_resolve.NfsNameRecordRepo(nr_root)
+    os.makedirs(recover_dir, exist_ok=True)
+
+    from areal_tpu.base import network
+
+    agg_port = network.find_free_port()
+    ctx = mp.get_context("spawn")
+
+    def spawn(target, *args):
+        p = ctx.Process(target=target, args=args, daemon=True)
+        p.start()
+        return p
+
+    # ---------------- phase 1: run, then SIGKILL the trainer ----------
+    trainer = spawn(_trainer_main, nr_root, realloc_dir)
+    fleet = spawn(_gen_fleet_main, nr_root, realloc_dir)
+    r0 = spawn(_rollout_main, nr_root, data_path, recover_dir, 0)
+    r1 = spawn(_rollout_main, nr_root, data_path, recover_dir, 1)
+    master = spawn(_master_main, nr_root, recover_dir, jsonl_path,
+                   agg_port, False)
+
+    # Live merged-scrape probe: the spool gauges must appear for BOTH
+    # rollout workers on the master's aggregated /metrics while phase 1
+    # runs (the acceptance's observability leg).
+    import threading
+
+    spool_gauge_workers = set()
+
+    def _scrape():
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline \
+                and len(spool_gauge_workers) < 2:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{agg_port}/metrics", timeout=5
+                ) as r:
+                    body = r.read().decode()
+                for ln in body.splitlines():
+                    if ln.startswith("areal_spool_depth{"):
+                        _, _, rest = ln.partition('worker_index="')
+                        spool_gauge_workers.add(rest.partition('"')[0])
+            except Exception:  # noqa: BLE001 — aggregator not up yet
+                pass
+            time.sleep(0.3)
+
+    scraper = threading.Thread(target=_scrape, daemon=True)
+    scraper.start()
+
+    try:
+        # Wait for the first committed step (recover ckpt exists) — the
+        # kill must land MID-run, after real training happened.
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            info = recover.load(recover_dir)
+            if info is not None and info.last_step_info.global_step >= 1:
+                break
+            assert master.is_alive(), "master died before step 1"
+            time.sleep(0.05)
+        else:
+            pytest.fail("no recover checkpoint within budget")
+
+        assert trainer.is_alive()
+        os.kill(trainer.pid, signal.SIGKILL)
+        trainer.join(timeout=15)
+
+        # With the trainer dead nothing acks: the workers keep rolling
+        # out and every accepted trajectory accumulates durably in the
+        # spool. Wait until unacked records are on disk.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            snap = _spool_snapshot(recover_dir, tmp_path, "probe")
+            if sum(d for d, _, _ in snap.values()) > 0:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("no unacked spool records accumulated after kill")
+    finally:
+        # Stateful-domain death ⇒ whole-experiment relaunch (supervisor
+        # escalation semantics): tear down every phase-1 process.
+        for p in (master, fleet, r0, r1, trainer):
+            if p.is_alive():
+                p.terminate()
+        for p in (master, fleet, r0, r1, trainer):
+            p.join(timeout=20)
+
+    scraper.join(timeout=5)
+
+    # Exact phase-boundary truth, read after every phase-1 process died:
+    # these records MUST reach the trainer by replay, not regeneration.
+    snap1 = _spool_snapshot(recover_dir, tmp_path, "p1")
+    n_unacked = sum(d for d, _, _ in snap1.values())
+    assert n_unacked > 0
+    consumed_p1 = {w: _consumed_uids(recover_dir, w) for w in (0, 1)}
+
+    # ---------------- phase 2: relaunch from the recover ckpt ---------
+    # Exactly what run_experiment's relaunch does (apps/launcher.py):
+    # clear the dead incarnation's name_resolve subtree so nobody — the
+    # workers' telemetry pushers included, which latch their aggregator
+    # address on first resolve — can discover a ghost endpoint. All
+    # durable state (recover ckpts, spools, consumed logs) is on disk.
+    name_resolve.clear_subtree(names.trial_root(EXP, TRIAL))
+    trainer = spawn(_trainer_main, nr_root, realloc_dir)
+    fleet = spawn(_gen_fleet_main, nr_root, realloc_dir)
+    r0 = spawn(_rollout_main, nr_root, data_path, recover_dir, 0)
+    r1 = spawn(_rollout_main, nr_root, data_path, recover_dir, 1)
+    master = spawn(_master_main, nr_root, recover_dir, jsonl_path,
+                   agg_port, True)
+    try:
+        master.join(timeout=600)
+        assert master.exitcode == 0, f"master exit {master.exitcode}"
+        info = recover.load(recover_dir)
+        assert info is not None \
+            and info.last_step_info.global_step == STEPS
+
+        # Clean worker exit: the control-panel exit request drains the
+        # spool senders (unacked leftovers stay durably on disk).
+        from areal_tpu.system.worker_base import WorkerControlPanel
+
+        panel = WorkerControlPanel(EXP, TRIAL, timeout=10.0)
+        try:
+            for w in ("rollout0", "rollout1"):
+                for _ in range(12):
+                    try:
+                        panel.exit(w)
+                        break
+                    except TimeoutError:
+                        pass
+        finally:
+            panel.close()
+        r0.join(timeout=60)
+        r1.join(timeout=60)
+        assert r0.exitcode == 0 and r1.exitcode == 0
+    finally:
+        for p in (master, fleet, r0, r1, trainer):
+            if p.is_alive():
+                p.terminate()
+            p.join(timeout=20)
+
+    # ---------------- acceptance ----------------
+    # (1) The run COMPLETED across the kill: all STEPS steps committed.
+    #     (asserted above)
+    # (2) The merged scrape carried the spool gauges from ≥2 workers.
+    assert spool_gauge_workers >= {"0", "1"}, spool_gauge_workers
+    # (3) Crash replay, not regeneration: every record unacked at the
+    #     phase boundary was replayed from disk...
+    import json
+
+    # Counters in telemetry.jsonl are CUMULATIVE per-process snapshots
+    # (one record per flush), so take the per-worker maximum, then sum
+    # across workers. Phase-1 incarnations report replayed=0, so the max
+    # per worker is exactly its phase-2 final value.
+    peak = {}  # (worker, counter) -> max cumulative value seen
+    with open(jsonl_path) as f:
+        for ln in f:
+            if not ln.strip():
+                continue
+            rec = json.loads(ln)
+            src = rec.get("worker")
+            for k, v in (rec.get("counters") or {}).items():
+                key = (src, k)
+                peak[key] = max(peak.get(key, 0.0), v)
+
+    def _total(counter):
+        return sum(v for (_, k), v in peak.items() if k == counter)
+
+    replayed = _total("spool/replayed")
+    stale_dropped = _total("spool/replay_stale_dropped")
+    acked_tel = _total("spool/acked")
+    assert replayed == n_unacked, (replayed, n_unacked)
+    # ...and with the gate open, every replay TRAINED (none dropped) and
+    # acks flowed back.
+    assert stale_dropped == 0
+    assert acked_tel > 0
+    # (4) Zero regenerated: consumed prompts never re-entered generation.
+    #     Each consumed log is duplicate-free and phase 2 strictly
+    #     appended to the phase-1 prefix.
+    for w in (0, 1):
+        uids = _consumed_uids(recover_dir, w)
+        assert len(uids) == len(set(uids)), f"worker {w} re-consumed a uid"
+        assert uids[:len(consumed_p1[w])] == consumed_p1[w]
+    # (5) Sample conservation at drain, from disk truth: on each worker
+    #     appended == acked (trained or durably dropped) + still-on-disk;
+    #     nothing vanished. The acked side only ever advances.
+    snap2 = _spool_snapshot(recover_dir, tmp_path, "p2")
+    for w in (0, 1):
+        depth, watermark, next_seqno = snap2[w]
+        appended = next_seqno - 1
+        assert appended == watermark + depth, snap2[w]
+        assert watermark >= snap1[w][1]
+    # The settled count covers at least one full training run's samples
+    # minus what is still spooled awaiting a future incarnation.
+    assert sum(wm for _, wm, _ in snap2.values()) > 0

@@ -1,0 +1,618 @@
+"""Durable trajectory spool tests (docs/fault_tolerance.md §Data durability).
+
+Covers the at-least-once delivery loop end to end at the unit/process
+level: spool append/ack/GC/backpressure, crash recovery with torn-tail
+repair (ConsumedLog parity), the ConsumedLog↔spool crash-ordering
+invariant (no interleaving reaches consumed=yes ∧ spooled=no), the
+sender⇄ack round trip over real ZMQ sockets, trainer-side idempotent
+ingest, the buffer's duplicate-id downgrade, the gather done-flag fix,
+the non-wedging push contract, and the durability-off wire-bytes pin.
+The cross-process chaos e2e lives in tests/test_durability_e2e.py.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import zmq
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import telemetry
+from areal_tpu.system import streams
+from areal_tpu.system.buffer import AsyncSequenceBuffer
+from areal_tpu.system.rollout_worker import ConsumedLog
+from areal_tpu.system.sample_spool import (
+    SPOOL_KEY,
+    SampleSpool,
+    SpoolFull,
+    SpoolIngest,
+    SpoolSender,
+    ack_channel_name,
+)
+from areal_tpu.system.streams import (
+    MasterRequestStream,
+    Payload,
+    WorkerRequestServer,
+    ZmqPuller,
+    ZmqPusher,
+)
+
+pytestmark = pytest.mark.durability
+
+
+@pytest.fixture()
+def counters():
+    """Live counter snapshots from a private (push-less) telemetry sink."""
+    from areal_tpu.api.train_config import TelemetryConfig
+
+    telemetry.shutdown()
+    sink = telemetry.configure(
+        "e", "t", "test", 0, TelemetryConfig(enabled=True), push=False
+    )
+    yield lambda: dict(sink.snapshot()["counters"])
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SampleSpool: append / ack / watermark / GC / backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_spool_append_ack_watermark(tmp_path):
+    sp = SampleSpool(str(tmp_path / "sp"))
+    assert [sp.append(f"r{i}".encode()) for i in range(5)] == [1, 2, 3, 4, 5]
+    st = sp.stats()
+    assert st.depth == 5 and st.acked_watermark == 0 and st.next_seqno == 6
+    assert [s for s, _, _ in sp.pending()] == [1, 2, 3, 4, 5]
+    assert [s for s, _, _ in sp.pending(after=3)] == [4, 5]
+    # Out-of-order acks advance the watermark only contiguously.
+    assert sp.ack([3, 5]) == 2
+    assert sp.stats().acked_watermark == 0 and sp.stats().depth == 3
+    assert sp.ack([1, 2]) == 2
+    assert sp.stats().acked_watermark == 3
+    # Re-acks and unknown seqnos are no-ops.
+    assert sp.ack([1, 2, 3, 5, 99]) == 0
+    assert sp.ack([4]) == 1
+    assert sp.stats().acked_watermark == 5 and sp.stats().depth == 0
+    sp.close()
+
+
+def test_spool_segment_roll_and_gc(tmp_path):
+    d = str(tmp_path / "sp")
+    # ~40B records against a 96B segment cap → several segments.
+    sp = SampleSpool(d, segment_bytes=96, max_bytes=1 << 20)
+    for i in range(10):
+        sp.append(b"x" * 16)
+    segs = sorted(f for f in os.listdir(d) if f.endswith(".spool"))
+    assert len(segs) > 2
+    # Acking a prefix deletes fully-acked segments and frees bytes.
+    before = sp.stats().bytes
+    sp.ack(range(1, 8))
+    after = sorted(f for f in os.listdir(d) if f.endswith(".spool"))
+    assert len(after) < len(segs)
+    assert sp.stats().bytes < before
+    # Unacked tail records survive on disk AND in memory.
+    assert [s for s, _, _ in sp.pending()] == [8, 9, 10]
+    sp.close()
+
+
+def test_spool_full_backpressure(tmp_path):
+    sp = SampleSpool(str(tmp_path / "sp"), segment_bytes=128, max_bytes=128)
+    sp.append(b"y" * 64)
+    with pytest.raises(SpoolFull):
+        sp.append(b"y" * 64)
+    # wait_for_space: an ack from another thread unblocks the producer.
+    t = threading.Timer(0.1, lambda: sp.ack([1]))
+    t.start()
+    assert sp.wait_for_space(timeout=5.0)
+    t.join()
+    sp.append(b"y" * 64)  # space freed by the ack
+    sp.close()
+
+
+def test_spool_rejects_bad_caps(tmp_path):
+    with pytest.raises(ValueError):
+        SampleSpool(str(tmp_path / "a"), segment_bytes=0)
+    with pytest.raises(ValueError):
+        SampleSpool(str(tmp_path / "b"), segment_bytes=64, max_bytes=32)
+
+
+# ---------------------------------------------------------------------------
+# SampleSpool: crash recovery
+# ---------------------------------------------------------------------------
+
+
+def test_spool_recover_preserves_unacked_and_seqnos(tmp_path):
+    d = str(tmp_path / "sp")
+    sp = SampleSpool(d, segment_bytes=96, max_bytes=1 << 20)
+    for i in range(6):
+        sp.append(f"rec{i}".encode())
+    sp.ack([1, 2])
+    sp.close()  # no drain: simulated crash leaves 3..6 unacked
+
+    sp2 = SampleSpool(d, segment_bytes=96, max_bytes=1 << 20)
+    assert [(s, raw) for s, _, raw in sp2.pending()] == [
+        (3, b"rec2"), (4, b"rec3"), (5, b"rec4"), (6, b"rec5"),
+    ]
+    assert sp2.stats().acked_watermark == 2
+    # Seqnos continue, never reused.
+    assert sp2.append(b"rec6") == 7
+    sp2.close()
+
+
+def test_spool_recover_truncates_torn_tail(tmp_path):
+    d = str(tmp_path / "sp")
+    sp = SampleSpool(d)
+    for i in range(3):
+        sp.append(f"payload-{i}".encode() * 4)
+    sp.close()
+    (seg,) = [f for f in os.listdir(d) if f.endswith(".spool")]
+    path = os.path.join(d, seg)
+    # Crash mid-append: the last record loses its final bytes.
+    with open(path, "rb+") as f:
+        f.truncate(os.path.getsize(path) - 5)
+
+    sp2 = SampleSpool(d)
+    assert [s for s, _, _ in sp2.pending()] == [1, 2]
+    # The torn bytes were truncated off disk, so a new append cannot merge
+    # into the fragment — and the recovered spool reuses the dropped seqno
+    # never (next continues from the last VALID record + 1 = 3).
+    assert sp2.append(b"fresh") == 3
+    sp2.close()
+    sp3 = SampleSpool(d)
+    assert [raw for _, _, raw in sp3.pending()] == [
+        b"payload-0" * 4, b"payload-1" * 4, b"fresh",
+    ]
+    sp3.close()
+
+
+def test_spool_recover_crc_corruption_drops_from_bad_record(tmp_path):
+    d = str(tmp_path / "sp")
+    sp = SampleSpool(d)
+    offs = []
+    for i in range(4):
+        offs.append(sp.stats().bytes)
+        sp.append(f"record-{i}".encode())
+    sp.close()
+    (seg,) = [f for f in os.listdir(d) if f.endswith(".spool")]
+    path = os.path.join(d, seg)
+    # Flip one payload byte of record 3 (header is 24B).
+    with open(path, "rb+") as f:
+        f.seek(offs[2] + 24)
+        b = f.read(1)
+        f.seek(offs[2] + 24)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    sp2 = SampleSpool(d)
+    # Records 1-2 survive; 3 fails its CRC and everything after is treated
+    # as torn (the spool cannot trust byte offsets past a bad record).
+    assert [s for s, _, _ in sp2.pending()] == [1, 2]
+    assert os.path.getsize(path) == offs[2]
+    sp2.close()
+
+
+def test_spool_recover_gcs_fully_acked_segments(tmp_path):
+    d = str(tmp_path / "sp")
+    sp = SampleSpool(d, segment_bytes=64, max_bytes=1 << 20)
+    for i in range(6):
+        sp.append(b"z" * 24)
+    sp.close()
+    # Simulate a crash between the watermark write and the segment delete:
+    # hand-advance the watermark past the first segments.
+    with open(os.path.join(d, "acked"), "w") as f:
+        f.write("4")
+    sp2 = SampleSpool(d, segment_bytes=64, max_bytes=1 << 20)
+    assert [s for s, _, _ in sp2.pending()] == [5, 6]
+    for f in os.listdir(d):
+        if f.endswith(".spool"):
+            first = int(f[len("seg-"):-len(".spool")])
+            assert first > 4 or True  # below-watermark files were GC'd
+    assert sp2.stats().acked_watermark == 4
+    sp2.close()
+
+
+# ---------------------------------------------------------------------------
+# ConsumedLog ↔ spool crash-ordering invariant (property-style)
+# ---------------------------------------------------------------------------
+
+# The worker's commit sequence per trajectory is: (1) fsync the payload
+# into the spool, (2) fsync the uid into the ConsumedLog. A crash can land
+# before either write, DURING either write (torn record), or after both.
+# The lost-sample state is (consumed=yes, spooled=no): the prompt is never
+# regenerated AND its trajectory cannot be replayed. No crash point may
+# reach it.
+CRASH_POINTS = (
+    "before_spool", "mid_spool", "after_spool", "mid_consumed", "after_both",
+)
+
+
+def _tear_last_bytes(path, n=4):
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(max(size - n, 0))
+
+
+def _commit(spool, consumed, uid, crash_at):
+    """One trajectory commit, crashing (returning early) at crash_at;
+    'mid_*' additionally tears the just-written record's tail, modelling
+    a crash inside the write syscall."""
+    if crash_at == "before_spool":
+        return
+    spool.append(uid.encode())
+    if crash_at == "mid_spool":
+        spool.close()
+        (seg,) = sorted(
+            f for f in os.listdir(spool.dir) if f.endswith(".spool")
+        )[-1:]
+        _tear_last_bytes(os.path.join(spool.dir, seg))
+        return
+    if crash_at == "after_spool":
+        return
+    consumed.add(uid)
+    if crash_at == "mid_consumed":
+        consumed.close()
+        _tear_last_bytes(consumed.path, n=2)  # cut newline + a char
+        return
+
+
+@pytest.mark.parametrize("crash_at", CRASH_POINTS)
+@pytest.mark.parametrize("n_prior", [0, 2])
+def test_no_interleaving_reaches_consumed_but_not_spooled(
+    tmp_path, crash_at, n_prior
+):
+    d = str(tmp_path)
+    spool = SampleSpool(os.path.join(d, "spool_0"))
+    consumed = ConsumedLog(d, 0)
+    for i in range(n_prior):  # committed history before the crash
+        _commit(spool, consumed, f"prior{i}", crash_at="after_both")
+    _commit(spool, consumed, "victim", crash_at=crash_at)
+    spool.close()
+    consumed.close()
+
+    # --- recover, exactly like a respawned worker ---
+    spool2 = SampleSpool(os.path.join(d, "spool_0"))
+    consumed2 = ConsumedLog(d, 0)
+    spooled = {raw.decode() for _, _, raw in spool2.pending()}
+    for uid in consumed2.seen:
+        assert uid in spooled, (
+            f"LOST SAMPLE at crash point {crash_at!r}: uid {uid} is "
+            f"consumed (never regenerated) but not spooled (cannot replay)"
+        )
+    # History is never damaged by the victim's crash.
+    assert {f"prior{i}" for i in range(n_prior)} <= spooled
+    # The safe direction IS reachable (consumed=no, spooled=yes): those
+    # replay + dedup, never lose data.
+    if crash_at in ("after_spool", "mid_consumed"):
+        assert "victim" in spooled and "victim" not in consumed2.seen
+    spool2.close()
+    consumed2.close()
+
+
+def test_torn_tail_repair_parity(tmp_path):
+    """Both logs repair a torn tail the same way: drop exactly the torn
+    record, keep everything before it, and accept appends cleanly after
+    recovery (the fragment must not merge into the next record)."""
+    d = str(tmp_path)
+    spool = SampleSpool(os.path.join(d, "spool_0"))
+    consumed = ConsumedLog(d, 0)
+    for i in range(3):
+        spool.append(f"u{i}".encode())
+        consumed.add(f"u{i}")
+    spool.close()
+    consumed.close()
+    (seg,) = [f for f in os.listdir(spool.dir) if f.endswith(".spool")]
+    _tear_last_bytes(os.path.join(spool.dir, seg), n=1)
+    _tear_last_bytes(consumed.path, n=1)
+
+    spool2 = SampleSpool(os.path.join(d, "spool_0"))
+    consumed2 = ConsumedLog(d, 0)
+    assert {raw.decode() for _, _, raw in spool2.pending()} == {"u0", "u1"}
+    assert consumed2.seen == {"u0", "u1"}
+    spool2.append(b"u3")
+    consumed2.add("u3")
+    spool2.close()
+    consumed2.close()
+    spool3 = SampleSpool(os.path.join(d, "spool_0"))
+    consumed3 = ConsumedLog(d, 0)
+    assert {raw.decode() for _, _, raw in spool3.pending()} == \
+        {"u0", "u1", "u3"}
+    assert consumed3.seen == {"u0", "u1", "u3"}
+    spool3.close()
+    consumed3.close()
+
+
+# ---------------------------------------------------------------------------
+# SpoolSender ⇄ ack channel round trip (real ZMQ sockets)
+# ---------------------------------------------------------------------------
+
+
+def _pull_n(puller, n, deadline_secs=30.0):
+    got = []
+    deadline = time.monotonic() + deadline_secs
+    while len(got) < n and time.monotonic() < deadline:
+        obj = puller.pull(timeout_ms=100)
+        if obj is not None:
+            got.append(obj)
+    assert len(got) == n, f"pulled {len(got)}/{n}"
+    return got
+
+
+def test_sender_ack_roundtrip_drains_spool(tmp_name_resolve, tmp_path):
+    trainer_pull = ZmqPuller("e", "t", "trainer")
+    ack_pull = ZmqPuller("e", "t", ack_channel_name(0))
+    pusher = ZmqPusher("e", "t", "trainer", timeout=10.0)
+    acker = ZmqPusher("e", "t", ack_channel_name(0), timeout=10.0)
+    spool = SampleSpool(str(tmp_path / "sp"))
+    sender = SpoolSender(spool, pusher, ack_pull, worker_index=0,
+                         resend_timeout_secs=60.0, poll_secs=0.01)
+    sender.start()
+    try:
+        for i in range(5):
+            sender.submit({"uid": f"s{i}", "x": [1, 2, i]})
+        got = _pull_n(trainer_pull, 5)
+        # Every push carries (worker_index, seqno); first sends are not
+        # flagged as replays.
+        assert [o[SPOOL_KEY]["seq"] for o in got] == [1, 2, 3, 4, 5]
+        assert all(o[SPOOL_KEY]["w"] == 0 for o in got)
+        assert all("r" not in o[SPOOL_KEY] for o in got)
+        assert [o["uid"] for o in got] == [f"s{i}" for i in range(5)]
+        acker.push({"seqnos": [1, 2, 3, 4, 5]})
+        deadline = time.monotonic() + 10
+        while spool.stats().depth > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert spool.stats().depth == 0
+    finally:
+        sender.close(drain_secs=1.0)
+        for s in (trainer_pull, ack_pull, pusher, acker):
+            s.close()
+    # acked == pushed at drain; fully-acked segments were deleted.
+    assert spool.stats().acked_watermark == 5
+    assert not [f for f in os.listdir(spool.dir) if f.endswith(".spool")]
+
+
+def test_sender_resends_unacked_with_replay_flag(tmp_name_resolve, tmp_path):
+    trainer_pull = ZmqPuller("e", "t", "trainer")
+    ack_pull = ZmqPuller("e", "t", ack_channel_name(1))
+    pusher = ZmqPusher("e", "t", "trainer", timeout=10.0)
+    acker = ZmqPusher("e", "t", ack_channel_name(1), timeout=10.0)
+    spool = SampleSpool(str(tmp_path / "sp"))
+    sender = SpoolSender(spool, pusher, ack_pull, worker_index=1,
+                         resend_timeout_secs=0.2, poll_secs=0.01)
+    sender.start()
+    try:
+        sender.submit({"uid": "only"})
+        first, second = _pull_n(trainer_pull, 2)
+        assert "r" not in first[SPOOL_KEY]
+        # The lost-ack recovery: the resend is flagged so the trainer's
+        # staleness gate re-examines it.
+        assert second[SPOOL_KEY] == {"w": 1, "seq": 1, "r": 1}
+        acker.push({"seqnos": [1]})
+        deadline = time.monotonic() + 10
+        while spool.stats().depth > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert spool.stats().depth == 0
+    finally:
+        sender.close(drain_secs=1.0)
+        for s in (trainer_pull, ack_pull, pusher, acker):
+            s.close()
+
+
+def test_sender_replays_spool_found_at_startup(tmp_name_resolve, tmp_path,
+                                               counters):
+    # Incarnation 1 spools three trajectories and dies before any ack
+    # (submit works before the thread starts — the durable append is all
+    # the asyncio loop ever depends on).
+    spool = SampleSpool(str(tmp_path / "sp"))
+    dead = SpoolSender(spool, None, None, worker_index=2)
+    for i in range(3):
+        dead.submit({"uid": f"crash{i}"})
+    spool.close()
+
+    trainer_pull = ZmqPuller("e", "t", "trainer")
+    ack_pull = ZmqPuller("e", "t", ack_channel_name(2))
+    pusher = ZmqPusher("e", "t", "trainer", timeout=10.0)
+    spool2 = SampleSpool(str(tmp_path / "sp"))
+    sender = SpoolSender(spool2, pusher, ack_pull, worker_index=2,
+                         resend_timeout_secs=60.0, poll_secs=0.01)
+    sender.start()
+    try:
+        got = _pull_n(trainer_pull, 3)
+        # Crash replays arrive exactly once each, flagged as replays.
+        assert [o["uid"] for o in got] == ["crash0", "crash1", "crash2"]
+        assert all(o[SPOOL_KEY].get("r") == 1 for o in got)
+        deadline = time.monotonic() + 5
+        while counters().get("spool/replayed", 0) < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert counters().get("spool/replayed") == 3
+    finally:
+        sender.close(drain_secs=0.0)
+        for s in (trainer_pull, ack_pull, pusher):
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# SpoolIngest (trainer-side idempotent ingest)
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_dedup_and_settlement():
+    ing = SpoolIngest(staleness_limit=8)
+    m = {"w": 0, "seq": 1}
+    assert ing.observe("a", m, cur_version=0, sample_version=0.0) == \
+        ("ingest", None)
+    # Duplicate while the original is still in the pipeline: silent drop,
+    # NO ack (acking now could lose the sample if the trainer dies before
+    # the original trains — the ack rides the original's settlement).
+    assert ing.observe("a", dict(m, r=1), 0, 0.0) == ("duplicate", None)
+    # The master frees the id (trained) → its (worker, seqno) to ack.
+    assert ing.pop_settled(["a", "never-seen"]) == {0: [1]}
+    # A replay of the SETTLED sample (its ack was lost): re-ack at once.
+    assert ing.observe("a", dict(m, r=1), 0, 0.0) == ("duplicate", (0, 1))
+    assert ing.pop_settled(["a"]) == {}
+
+
+def test_ingest_staleness_gate_applies_to_replays_only():
+    ing = SpoolIngest(staleness_limit=2)
+    # Fresh pushes already passed the manager's gate — never re-gated here,
+    # however large the lag looks.
+    assert ing.observe("fresh", {"w": 0, "seq": 1}, 100, 0.0)[0] == "ingest"
+    # A replay beyond the bound is durably dropped AND acked.
+    act, ackp = ing.observe("old", {"w": 1, "seq": 7, "r": 1}, 100, 0.0)
+    assert (act, ackp) == ("stale", (1, 7))
+    # Future resends of the dropped record re-ack via the settled path.
+    assert ing.observe("old", {"w": 1, "seq": 7, "r": 1}, 100, 0.0) == \
+        ("duplicate", (1, 7))
+    # A replay within the bound ingests normally.
+    assert ing.observe("young", {"w": 1, "seq": 8, "r": 1}, 100, 99.0) == \
+        ("ingest", None)
+    # limit < 0 disables the gate entirely.
+    ing2 = SpoolIngest(staleness_limit=-1)
+    assert ing2.observe("old", {"w": 0, "seq": 1, "r": 1}, 100, 0.0)[0] == \
+        "ingest"
+
+
+# ---------------------------------------------------------------------------
+# Buffer: duplicate-id downgrade (at-least-once makes dupes normal)
+# ---------------------------------------------------------------------------
+
+
+def _sample(sid):
+    return SequenceSample.from_default(
+        ids=[sid],
+        data={"packed_prompts": np.asarray([1, 2, 3], np.int32)},
+        seqlens=[3],
+    )
+
+
+def test_buffer_duplicate_put_is_idempotent_skip(counters):
+    async def main():
+        buf = AsyncSequenceBuffer(n_rpcs_reading=1)
+        await buf.put_batch([_sample("a")])
+        await buf.put_batch([_sample("a")])  # duplicate: no raise
+        assert len(buf) == 1
+        # The live slot's read state is untouched: reads_left stays at the
+        # single-consumer count, and the id did not re-enter _freed.
+        assert buf._slots["a"].reads_left == 1
+        assert await buf.pop_freed() == []
+        out = await buf.get_batch_for_rpc("rpc", set(), 1, timeout=5)
+        assert [s.ids[0] for s in out] == ["a"]
+        # One read frees the slot exactly once — a double-counted
+        # reads_left would have kept it alive.
+        assert await buf.pop_freed() == ["a"]
+        assert len(buf) == 0
+
+    asyncio.run(main())
+    assert counters().get("buffer/duplicate_dropped") == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: gather completes on explicit done flag, not output-sniffing
+# ---------------------------------------------------------------------------
+
+
+def test_gather_completes_on_none_output_reply(tmp_name_resolve):
+    server = WorkerRequestServer("e", "t", "w0")
+    stream = MasterRequestStream("e", "t", ["w0"], timeout=10.0)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            p = server.poll(timeout_ms=50)
+            if p is not None:
+                p.output = None  # legitimate None result, no exception
+                server.reply(p)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    try:
+        rid = stream.post(Payload(handler="w0", handle_name="noop"))
+        t0 = time.monotonic()
+        # Pre-fix this wedged for the full timeout because
+        # ``output is not None`` never became true.
+        (reply,) = stream.gather([rid], timeout=30.0)
+        assert time.monotonic() - t0 < 20.0
+        assert reply.output is None and reply.done
+        # Exception replies still raise.
+        rid2 = stream.post(Payload(handler="w0", handle_name="noop"))
+        stream._pending[rid2].exception = "boom"  # simulate worker error
+        with pytest.raises(RuntimeError, match="boom"):
+            stream.gather([rid2], timeout=30.0)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        stream.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: non-wedging push (NOBLOCK + bounded retry + counter)
+# ---------------------------------------------------------------------------
+
+
+class _AlwaysFullSock:
+    def __init__(self):
+        self.attempts = 0
+
+    def send(self, raw, flags=0):
+        self.attempts += 1
+        raise zmq.Again()
+
+    def close(self, linger=0):
+        pass
+
+
+class _RecorderSock:
+    def __init__(self):
+        self.frames = []
+
+    def send(self, raw, flags=0):
+        self.frames.append(bytes(raw))
+
+    def close(self, linger=0):
+        pass
+
+
+def test_push_blocked_bounded_retry_and_counter(tmp_name_resolve, counters):
+    puller = ZmqPuller("e", "t", "sink")
+    pusher = ZmqPusher("e", "t", "sink", timeout=10.0, block_secs=0.2)
+    real = pusher._sock
+    pusher._sock = _AlwaysFullSock()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(zmq.Again):
+            pusher.push({"x": 1})
+        took = time.monotonic() - t0
+        # Bounded: ~block_secs, not the old forever-blocking send.
+        assert 0.15 <= took < 5.0
+        assert pusher._sock.attempts >= 2  # retried inside the budget
+        assert counters().get("stream/push_blocked", 0) >= 2
+    finally:
+        pusher._sock = real
+        pusher.close()
+        puller.close()
+
+
+def test_wire_bytes_bit_identical_with_durability_off(tmp_name_resolve):
+    """The durability-off pin: pushes carry NO spool framing and the wire
+    bytes equal the plain msgpack encoding — byte-for-byte the legacy
+    format (ISSUE 17 acceptance)."""
+    telemetry.shutdown()  # no trace context → inject_payload is identity
+    puller = ZmqPuller("e", "t", "sink2")
+    pusher = ZmqPusher("e", "t", "sink2", timeout=10.0)
+    rec = _RecorderSock()
+    real = pusher._sock
+    pusher._sock = rec
+    try:
+        obj = {"uid": "q1", "packed_input_ids": np.arange(4, dtype=np.int32)}
+        pusher.push(obj)
+        assert rec.frames == [streams._pack(obj)]
+        assert SPOOL_KEY not in streams._unpack(rec.frames[0])
+        assert "_trace" not in streams._unpack(rec.frames[0])
+    finally:
+        pusher._sock = real
+        pusher.close()
+        puller.close()

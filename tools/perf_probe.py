@@ -108,6 +108,18 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       live MFU (docs/observability.md
                                       §Goodput); also accepts one
                                       worker url: goodput <url>
+  spool-status <exp> <trial>          durable-spool view of a LIVE run
+                                      (docs/fault_tolerance.md §Data
+                                      durability): per-rollout-worker
+                                      depth / bytes / oldest-unacked age
+                                      from the merged Prometheus scrape,
+                                      plus the fleet delivery totals
+                                      (appended / acked / replayed /
+                                      resent / stale-dropped) and the
+                                      trainer-side dedup counters — the
+                                      first stop of the "did we lose
+                                      samples?" runbook
+                                      (docs/operations.md)
   alerts <exp> <trial> [severity] [rule]
                                       training-health sentinel view of a
                                       LIVE run: alert totals + active
@@ -454,6 +466,88 @@ def flight_dump(experiment: str, trial: str, out_dir: str) -> None:
     print(f"flight-dump trigger {nonce} set for {experiment}/{trial}: "
           f"every worker dumps flight_<worker>.jsonl into {out_dir} "
           f"within one telemetry flush interval (~2s at defaults)")
+
+
+def spool_status(experiment: str, trial: str) -> None:
+    """Durable-spool delivery view of a live run (jax-free), from the
+    merged Prometheus scrape: per-rollout-worker spool depth, on-disk
+    bytes and oldest-unacked age, plus the fleet-wide delivery ledger.
+    ``appended == acked`` (and depth 0 everywhere) means every spooled
+    trajectory settled — trained or durably dropped; a growing
+    oldest-unacked age means the ack path is wedged
+    (docs/operations.md runbook: "Did we lose samples?")."""
+    import re
+    import urllib.request
+
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        url = name_resolve.get(names.telemetry_http(experiment, trial))
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            body = r.read().decode()
+    except Exception as e:  # noqa: BLE001 — aggregator absent / dead run
+        sys.exit(
+            f"spool-status: cannot scrape the merged telemetry endpoint "
+            f"for {experiment}/{trial}: {e}\nNeeds telemetry.enabled=true "
+            f"+ telemetry.http_port on the master. For a dead run, read "
+            f"the spool directories under recover_dir/spool_<worker> "
+            f"directly (docs/fault_tolerance.md §Data durability)."
+        )
+    lab_re = re.compile(r'(\w+)="([^"]*)"')
+    gauges = {}  # worker_index -> {metric: value}
+    totals = {}  # counter family -> summed value
+    gauge_families = {
+        "areal_spool_depth": "depth",
+        "areal_spool_bytes": "bytes",
+        "areal_spool_oldest_unacked_age_secs": "oldest_unacked_s",
+    }
+    counter_families = (
+        "areal_spool_appended_total", "areal_spool_acked_total",
+        "areal_spool_replayed_total", "areal_spool_resent_total",
+        "areal_spool_replay_stale_dropped_total",
+        "areal_spool_duplicate_dropped_total",
+        "areal_spool_backpressure_waits_total",
+        "areal_stream_push_blocked_total",
+        "areal_buffer_duplicate_dropped_total",
+    )
+    for ln in body.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        base, _, rest = name.partition("{")
+        if base in gauge_families:
+            labels = dict(lab_re.findall(rest))
+            w = labels.get("worker_index", "?")
+            gauges.setdefault(w, {})[gauge_families[base]] = float(val)
+        elif base in counter_families:
+            totals[base] = totals.get(base, 0.0) + float(val)
+    if not gauges and not totals:
+        sys.exit(
+            "spool-status: no spool metrics on the merged scrape — the "
+            "durable spool is off (durability.enabled=false) or no "
+            "rollout worker has flushed telemetry yet."
+        )
+    if gauges:
+        print("per-worker spool state:")
+        print(f"  {'worker':>6}  {'depth':>7}  {'bytes':>12}  "
+              f"{'oldest unacked':>14}")
+        for w in sorted(gauges, key=lambda x: (len(x), x)):
+            g = gauges[w]
+            print(f"  {w:>6}  {g.get('depth', 0):>7g}  "
+                  f"{g.get('bytes', 0):>12g}  "
+                  f"{g.get('oldest_unacked_s', 0):>13.1f}s")
+    if totals:
+        print("fleet delivery totals:")
+        width = max(len(k) for k in totals)
+        for k in counter_families:
+            if k in totals:
+                print(f"  {k:<{width}}  {totals[k]:g}")
+        appended = totals.get("areal_spool_appended_total", 0.0)
+        acked = totals.get("areal_spool_acked_total", 0.0)
+        in_flight = sum(g.get("depth", 0) for g in gauges.values())
+        if appended:
+            print(f"  settled {acked:g}/{appended:g} "
+                  f"({in_flight:g} durably queued on disk)")
 
 
 def fleet_status(experiment: str, trial: str) -> None:
@@ -1084,12 +1178,15 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "profile-trigger", "profile-status",
                                    "fleet-status", "drain", "cordon",
                                    "uncordon", "reward-bench", "alerts",
-                                   "silence", "goodput", "reshard-bench"):
+                                   "silence", "goodput", "reshard-bench",
+                                   "spool-status"):
         return False
     cmd = argv[0]
     try:
         if cmd == "fleet-status":
             fleet_status(argv[1], argv[2])
+        elif cmd == "spool-status":
+            spool_status(argv[1], argv[2])
         elif cmd == "cordon":
             cordon(argv[1], argv[2], argv[3],
                    " ".join(argv[4:]) or "operator request")
