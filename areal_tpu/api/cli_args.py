@@ -488,6 +488,24 @@ def validate_config(cfg) -> None:
                     f"{spec.world_size} devices but the experiment has "
                     f"n_nodes×n_gpus_per_node = {n_devices}"
                 )
+        # Generation-side specs never ring: the decode hot loop passes
+        # allow_ring=False (models/transformer.py) so an sp axis there
+        # would silently replicate work at server launch. Fail at parse
+        # time with the fix instead.
+        gen_specs = [("generation", alloc.gen_spec)]
+        gen_specs += [(f"MFC '{m}'", s) for m, s in
+                      sorted(alloc.per_mfc.items()) if m == "actor_gen"]
+        for label, spec in gen_specs:
+            if spec is not None and spec.sp > 1:
+                raise ConfigError(
+                    f"allocation_mode {label} spec '{spec}' sets sp="
+                    f"{spec.sp}, but sequence (ring) parallelism only "
+                    "applies to training: the decode hot loop never rings "
+                    "(token-at-a-time attention has no sequence dim to "
+                    "shard). Move the sp factor into dp or tp for the "
+                    "generation fleet — e.g. sp2 -> d2 "
+                    "(docs/parallelism.md §PP∘SP)."
+                )
     nr = getattr(getattr(cfg, "cluster", None), "name_resolve", None)
     if nr is not None and getattr(nr, "type", "nfs") == "etcd3":
         # Same contract as the mode=ray rejection above: the descoped

@@ -40,6 +40,7 @@ from areal_tpu.base import logging, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer
 from areal_tpu.models.config import TransformerConfig
+from areal_tpu.parallel import pipeline as ppl
 from areal_tpu.parallel import sharding as psh
 
 logger = logging.getLogger("backend.jax")
@@ -250,10 +251,39 @@ class JaxTrainEngine(TrainableEngine):
 
     def _mesh_ctx(self):
         if self.mesh is not None:
-            return psh.activation_sharding(self.mesh)
+            rules = None
+            if self.mesh.shape.get("sp", 1) > 1:
+                # jax 0.4.x GSPMD miscompiles concatenate/shift ops that
+                # get partitioned along a sharded dim (per-shard partials
+                # come back summed — a next-token shift mask doubled). So
+                # outside manual regions the sequence dim stays UNSHARDED:
+                # the ring/pipeline shard_maps reshard at their boundary,
+                # sp still shards every transformer layer — only
+                # embed/head/loss replicate over the ring.
+                rules = psh.rules_without_axes(("sp",))
+            return psh.activation_sharding(self.mesh, rules)
         import contextlib
 
         return contextlib.nullcontext()
+
+    def _unshard_sp(self, x, vocab_tp: bool = False):
+        """Gather the sequence dim off the sp ring at the model boundary.
+
+        jax 0.4.x GSPMD miscompiles shift/concat ops along an sp-sharded
+        dim (a next-token shift mask came back with every value doubled —
+        per-shard partials summed — on pp×sp meshes). Loss and logprob
+        code shifts along the sequence dim constantly, so model outputs
+        must leave the model with seq unsharded; dp/fsdp/tp stay."""
+        if self.mesh is None or self.mesh.shape.get("sp", 1) <= 1:
+            return x
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        tail = ["tp" if vocab_tp else None] * (x.ndim - 2)
+        spec = P(psh.DATA_AXES, None, *tail)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec)
+        )
 
     def _cast(self, params):
         cd = self.compute_dtype
@@ -282,9 +312,11 @@ class JaxTrainEngine(TrainableEngine):
         # the compute dtype — loss fns upcast per-element inside fused
         # reductions (see ppo_functional.gather_logprobs).
         out = out.astype(jnp.float32) if self.cfg.is_critic else out
+        out = self._unshard_sp(out, vocab_tp=not self.cfg.is_critic)
         return (out, aux) if with_aux else out
 
-    def _forward_token_logprobs(self, params, batch: Dict[str, jnp.ndarray]):
+    def _forward_token_logprobs(self, params, batch: Dict[str, jnp.ndarray],
+                                loss_batch=None):
         """[R, L] per-token logprobs with a CHUNKED head: the [R, L, V]
         logits grid never materializes (at a 152k vocab it is the single
         biggest activation, ~2.4GB at [8,1024] incl. its cotangent — the
@@ -293,9 +325,15 @@ class JaxTrainEngine(TrainableEngine):
         chunk logits instead of storing them — the head matmul is redone
         once (~25% of forward FLOPs at 0.5B) to free the grid; role parity:
         the reference's fused vocab-parallel cross entropy
-        (tensor_parallel/modules.py:1060) exists for the same reason."""
+        (tensor_parallel/modules.py:1060) exists for the same reason.
+
+        ``loss_batch``: the sp-decoupled duplicate of ``batch`` (see
+        _get_grad_fn) — label shifts and score masking read from it so
+        sharding propagation from the model's sp constraints can never
+        reach the shift ops."""
         from areal_tpu.algorithms import ppo_functional as F
 
+        lb = batch if loss_batch is None else loss_batch
         cast = self._cast(params)
         h, _, aux = transformer.forward(
             cast, self.cfg,
@@ -305,8 +343,9 @@ class JaxTrainEngine(TrainableEngine):
             return_kv=False, return_aux=True, return_hidden=True,
             rng=batch.get("rng"),
         )
+        h = self._unshard_sp(h)
         R, L, D = h.shape
-        labels = F.next_token_labels(batch["tokens"])
+        labels = F.next_token_labels(lb["tokens"])
         C = self.logprob_chunk or L
         if L % C != 0:
             C = L  # bucketing guarantees divisibility in practice
@@ -326,7 +365,7 @@ class JaxTrainEngine(TrainableEngine):
             ls = labels.reshape(R, n, C).transpose(1, 0, 2)
             s = jax.lax.map(lambda args: chunk_scores(*args), (hs, ls))
             s = s.transpose(1, 0, 2).reshape(R, L)
-        return F.shift_mask_scores(s, batch["segment_ids"]), aux
+        return F.shift_mask_scores(s, lb["segment_ids"]), aux
 
     def _use_chunked_logprobs(self, fn) -> bool:
         return (
@@ -350,18 +389,31 @@ class JaxTrainEngine(TrainableEngine):
 
         Keyed by the function OBJECT (keeps it alive): an id() key could
         be reused by a new closure after GC and silently run stale code.
+
+        ``loss_batch`` is the SAME device buffers as ``batch``, passed as a
+        second jit parameter: on sp>1 meshes the model constrains its
+        inputs over "sp", and jax 0.4.x GSPMD then miscompiles shift /
+        concat ops along the sp-sharded dim in downstream code (next-token
+        shift masks came back with per-shard partials summed). Loss fns
+        shift along seq constantly. Two HLO parameters are invisible to
+        sharding propagation, so loss code reading ``loss_batch`` (and
+        model output passed through _unshard_sp) carries no sp pressure —
+        zero-copy at call time, the arrays are fed twice.
         """
         key = (loss_fn, with_carry)
         use_lp = self._use_chunked_logprobs(loss_fn)
         if key not in self._grad_fns:
 
-            def f(params, batch, denom, scale, aux_scale, carry=None):
+            def f(params, batch, loss_batch, denom, scale, aux_scale,
+                  carry=None):
                 def lf(p):
                     if use_lp:
-                        out, aux = self._forward_token_logprobs(p, batch)
+                        out, aux = self._forward_token_logprobs(
+                            p, batch, loss_batch
+                        )
                     else:
                         out, aux = self._model_forward(p, batch, with_aux=True)
-                    loss_sum, stats = loss_fn(out, batch)
+                    loss_sum, stats = loss_fn(out, loss_batch)
                     loss = loss_sum / jnp.maximum(denom, 1.0)
                     if aux:
                         # MoE balancing losses (reference utils/moe.py aux
@@ -390,7 +442,7 @@ class JaxTrainEngine(TrainableEngine):
                     grads = jax.tree.map(jnp.add, grads, c_grads)
                 return loss, stats, grads
 
-            donate = (5,) if with_carry else ()
+            donate = (6,) if with_carry else ()
             self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
         return self._grad_fns[key]
 
@@ -465,6 +517,9 @@ class JaxTrainEngine(TrainableEngine):
             )
             telemetry.set_gauge("train/pack_fill", mbu.pack_fill(mbs))
         R, L = mbs[0].layout.shape
+        pp_on, ring_on = ppl.pp_engagement(self.mesh, self.cfg, R, L)
+        telemetry.set_gauge("train/pp_engaged", pp_on)
+        telemetry.set_gauge("train/ring_engaged", ring_on)
         S = max(len(mb.seq_mask) for mb in mbs)
         S = mbu.packing.round_up(S, self.seqs_bucket)
         grids: Dict[str, jnp.ndarray] = {}
@@ -528,23 +583,33 @@ class JaxTrainEngine(TrainableEngine):
         use_lp = self._use_chunked_logprobs(loss_fn)
         if key not in self._grad_fns:
 
-            def f(params, grids, seq, mb_idx, denom, scale, aux_scale,
-                  carry=None):
-                batch = {
-                    k: jax.lax.dynamic_slice_in_dim(g, mb_idx * R, R, 0)
-                    for k, g in grids.items()
-                }
-                for k, v in seq.items():
-                    batch[k] = jax.lax.dynamic_index_in_dim(
-                        v, mb_idx, 0, keepdims=False
-                    )
+            def f(params, grids, seq, loss_grids, loss_seq, mb_idx, denom,
+                  scale, aux_scale, carry=None):
+                # loss_grids/loss_seq are the same buffers as grids/seq fed
+                # as separate jit params — the sp-decoupling described in
+                # _get_grad_fn; the loss-side slice is re-done from them.
+                def slice_mb(gs, sq):
+                    b = {
+                        k: jax.lax.dynamic_slice_in_dim(g, mb_idx * R, R, 0)
+                        for k, g in gs.items()
+                    }
+                    for k, v in sq.items():
+                        b[k] = jax.lax.dynamic_index_in_dim(
+                            v, mb_idx, 0, keepdims=False
+                        )
+                    return b
+
+                batch = slice_mb(grids, seq)
+                loss_batch = slice_mb(loss_grids, loss_seq)
 
                 def lf(p):
                     if use_lp:
-                        out, aux = self._forward_token_logprobs(p, batch)
+                        out, aux = self._forward_token_logprobs(
+                            p, batch, loss_batch
+                        )
                     else:
                         out, aux = self._model_forward(p, batch, with_aux=True)
-                    loss_sum, stats = loss_fn(out, batch)
+                    loss_sum, stats = loss_fn(out, loss_batch)
                     loss = loss_sum / jnp.maximum(denom, 1.0)
                     if aux:
                         loss = loss + aux["aux_total"] * aux_scale
@@ -568,7 +633,7 @@ class JaxTrainEngine(TrainableEngine):
                     grads = jax.tree.map(jnp.add, grads, c_grads)
                 return loss, stats, grads
 
-            donate = (7,) if with_carry else ()
+            donate = (9,) if with_carry else ()
             self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
         return self._grad_fns[key]
 
@@ -618,7 +683,8 @@ class JaxTrainEngine(TrainableEngine):
                     loss_fn, with_carry=carry is not None, R=ub.R
                 )
                 args = [
-                    self.params, ub.grids, seq, jnp.asarray(i, jnp.int32),
+                    self.params, ub.grids, seq, dict(ub.grids), dict(seq),
+                    jnp.asarray(i, jnp.int32),
                     jnp.asarray(denom, jnp.float32),
                     jnp.asarray(scale, jnp.float32),
                     jnp.asarray(aux_scale, jnp.float32),
@@ -701,6 +767,11 @@ class JaxTrainEngine(TrainableEngine):
                 fill_bucket=self.fill_bucket,
             )
             telemetry.set_gauge("train/pack_fill", mbu.pack_fill(mbs))
+        mb_rows, mb_len = mbs[0].layout.shape
+        pp_on, ring_on = ppl.pp_engagement(self.mesh, self.cfg, mb_rows,
+                                           mb_len)
+        telemetry.set_gauge("train/pp_engaged", pp_on)
+        telemetry.set_gauge("train/ring_engaged", ring_on)
         weights = [float(loss_weight_fn(mb)) for mb in mbs]
         total_w = sum(weights)
         rule = None
@@ -731,7 +802,8 @@ class JaxTrainEngine(TrainableEngine):
                 grad_fn = self._get_grad_fn(loss_fn,
                                             with_carry=carry is not None)
                 args = [
-                    self.params, batch, jnp.asarray(denom, jnp.float32),
+                    self.params, batch, dict(batch),
+                    jnp.asarray(denom, jnp.float32),
                     jnp.asarray(scale, jnp.float32),
                     jnp.asarray(aux_scale, jnp.float32),
                 ]
@@ -899,19 +971,25 @@ class JaxTrainEngine(TrainableEngine):
         key = (id(post_hook), use_lp)
         if key not in self._fwd_fns:
 
-            def f(params, batch):
+            def f(params, batch, loss_batch):
+                # loss_batch: sp-decoupled duplicate (see _get_grad_fn) —
+                # the post hook is user code that shifts along seq.
                 if use_lp:
-                    out, _ = self._forward_token_logprobs(params, batch)
+                    out, _ = self._forward_token_logprobs(
+                        params, batch, loss_batch
+                    )
                 else:
                     out = self._model_forward(params, batch)
-                return post_hook(out, batch) if post_hook is not None else out
+                return (post_hook(out, loss_batch)
+                        if post_hook is not None else out)
 
             self._fwd_fns[key] = jax.jit(f)
         fn = self._fwd_fns[key]
         outs = []
         for mb in mbs:
+            db = self._device_batch(mb)
             with self._mesh_ctx():
-                outs.append(np.asarray(fn(self.params, self._device_batch(mb))))
+                outs.append(np.asarray(fn(self.params, db, dict(db))))
         return mbu.scatter_back(mbs, outs, input_.bs)
 
     def generate(

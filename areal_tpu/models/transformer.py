@@ -170,6 +170,7 @@ def _block(
     kv_valid: Optional[jnp.ndarray],
     attn_impl: str,
     allow_ring: bool = True,
+    ring_ctx=None,  # ring.RingCtx — already inside a manual sp region (PP∘SP)
     rng: Optional[jnp.ndarray] = None,  # per-layer key for MoE router jitter
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], Optional[Dict[str, jnp.ndarray]]]:
     B, T, D = h.shape
@@ -194,25 +195,26 @@ def _block(
         k = apply_rope(k, cos, sin)
 
     if cache_kv is None:
+        from areal_tpu.parallel import ring as ring_mod
+
         mesh = current_mesh()
-        # Ring attention needs shard_map-divisible shapes; shapes that don't
-        # divide (e.g. generate()'s unbucketed batch dim) keep the tolerant
-        # GSPMD path.
+        # Ring attention needs shard_map-divisible shapes; shapes that
+        # don't divide (e.g. generate()'s unbucketed batch dim) keep the
+        # tolerant GSPMD path.
         use_ring = (
             allow_ring
-            and mesh is not None
-            and mesh.shape.get("sp", 1) > 1
-            and cfg.sliding_window is None
-            and B % (mesh.shape["dp"] * mesh.shape["fsdp"]) == 0
-            and T % mesh.shape["sp"] == 0
-            and cfg.n_q_heads % mesh.shape["tp"] == 0
-            and cfg.n_kv_heads % mesh.shape["tp"] == 0
+            and segment_ids is not None
+            and ring_mod.ring_eligible(mesh, cfg, B, T)
         )
-        if use_ring:
+        if allow_ring and ring_ctx is not None:
+            # Already inside a manual region over the ring axis (the PP∘SP
+            # pipeline stages): run the local ring body directly — a
+            # nested shard_map would be rejected there.
+            attn = ring_mod.ring_attention_inline(q, k, v, segment_ids,
+                                                  ring_ctx)
+        elif use_ring:
             # Sequence dim sharded → context-parallel ring attention.
-            from areal_tpu.parallel.ring import ring_attention
-
-            attn = ring_attention(q, k, v, segment_ids, mesh)
+            attn = ring_mod.ring_attention(q, k, v, segment_ids, mesh)
         else:
             attn = packed_attention(
                 q, k, v, segment_ids, segment_ids,
@@ -281,12 +283,14 @@ def apply_layer_stack(
     attn_impl: str = "auto",
     remat=False,
     allow_ring: bool = True,
+    ring_ctx=None,  # ring.RingCtx when inside a manual sp region (PP∘SP)
     rng: Optional[jnp.ndarray] = None,
 ):
     """Run a stacked layer dict over ``h`` via lax.scan (packed mode, no KV
     out). Returns (h, aux) where aux stacks per-layer MoE scalars ({} for
     dense). Shared by the GSPMD scan path and the pipeline-parallel stages
-    (parallel/pipeline.py, which passes each stage's LOCAL slice).
+    (parallel/pipeline.py, which passes each stage's LOCAL slice — plus a
+    ``ring_ctx`` under PP∘SP so attention rings inside the stage).
 
     ``remat``: False | True/"full" (recompute the whole layer in backward)
     | "dots" (save matmul outputs, recompute elementwise/norm/cast —
@@ -304,7 +308,8 @@ def apply_layer_stack(
             lp, key = xs
             h2, _, aux = _block(
                 cfg, h, lp, cos, sin, segment_ids, positions,
-                None, None, None, attn_impl, allow_ring=allow_ring, rng=key,
+                None, None, None, attn_impl, allow_ring=allow_ring,
+                ring_ctx=ring_ctx, rng=key,
             )
             return h2, aux
 
@@ -316,6 +321,7 @@ def apply_layer_stack(
         h2, _, aux = _block(
             cfg, h, lp, cos, sin, segment_ids, positions,
             None, None, None, attn_impl, allow_ring=allow_ring,
+            ring_ctx=ring_ctx,
         )
         return h2, aux
 
@@ -403,7 +409,7 @@ def forward(
 
         mesh = current_mesh()
         n_micro = pp_mod.pick_pp_microbatches(
-            mesh, cfg, h.shape[0], pp_microbatches
+            mesh, cfg, h.shape[0], pp_microbatches, seq_len=h.shape[1]
         )
         if n_micro is not None:
             # Real pipeline parallelism: micro-batches stream through the

@@ -53,6 +53,7 @@ the server).
 from __future__ import annotations
 
 import os
+from contextlib import nullcontext
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -61,8 +62,39 @@ import numpy as np
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from areal_tpu.base import logging, telemetry
 from areal_tpu.models.config import TransformerConfig
+from areal_tpu.parallel import ring as ring_mod
+from areal_tpu.parallel import sharding as psh
 from areal_tpu.parallel.compat import shard_map
+
+logger = logging.getLogger("parallel.pipeline")
+
+# One-time-per-reason WARN dedup for the GSPMD fallback (process-global:
+# the gate runs per trace, the operator needs the reason once).
+_WARNED_FALLBACKS: set = set()
+
+_FALLBACK_HINTS = {
+    "layers_indivisible": "n_layers must divide the pp axis",
+    "batch_too_small": "batch has no divisor in [pp, 2*pp]",
+    "requested_indivisible": "requested micro-batch count must divide batch",
+    "old_jax_mixed_mesh": "this jax only pipelines pure pp/pp×sp meshes",
+    "sp_seq_indivisible": "seq_len must divide the sp axis to ring",
+    "sp_sliding_window": "sliding-window attention is not ring-expressible",
+}
+
+
+def _fallback(reason: str) -> None:
+    """GSPMD-fallback bookkeeping: a counter per reason plus a one-time
+    WARN naming the failed gate (ROADMAP item 2 — the silent fallback)."""
+    telemetry.inc(f"parallel/pp_fallback{{reason={reason}}}")
+    if reason not in _WARNED_FALLBACKS:
+        _WARNED_FALLBACKS.add(reason)
+        logger.warning(
+            "pipeline disengaged, falling back to GSPMD layer sharding: "
+            "%s (%s)", reason, _FALLBACK_HINTS.get(reason, "")
+        )
+    return None
 
 
 def pick_pp_microbatches(
@@ -70,45 +102,76 @@ def pick_pp_microbatches(
     cfg: TransformerConfig,
     batch: int,
     requested: Optional[int] = None,
+    seq_len: Optional[int] = None,
 ) -> Optional[int]:
     """The pipeline-eligibility gate: returns the micro-batch count, or
     None when the GSPMD scan path should run instead.
 
-    Requirements: a "pp" axis > 1, layers divisible across stages, a batch
-    divisible into >= pp micro-batches, and sp == 1 (ring attention runs
-    its own shard_map; composing it inside a manual-pp region is future
-    work — such meshes fall back to GSPMD layer sharding, which is correct,
-    just not pipelined).
+    Requirements: a "pp" axis > 1, layers divisible across stages, and a
+    batch divisible into >= pp micro-batches. Meshes with sp > 1 pipeline
+    too (PP∘SP): ring attention runs *inside* each stage, manual over
+    {"pp","sp"}, which additionally needs the sequence to shard over the
+    ring (``seq_len % sp == 0``) and a ring-expressible attention pattern
+    (no sliding window). Every fallback WARNs once and bumps the
+    ``parallel/pp_fallback{reason=...}`` counter.
     """
     if mesh is None:
         return None
     pp = mesh.shape.get("pp", 1)
-    if pp <= 1 or mesh.shape.get("sp", 1) > 1:
-        return None
+    if pp <= 1:
+        return None  # no pipeline requested — not a fallback
+    sp = mesh.shape.get("sp", 1)
+    if sp > 1:
+        if seq_len is None or seq_len % sp != 0:
+            return _fallback("sp_seq_indivisible")
+        if cfg.sliding_window is not None:
+            return _fallback("sp_sliding_window")
     if cfg.n_layers % pp != 0:
-        return None
+        return _fallback("layers_indivisible")
     if getattr(jax, "shard_map", None) is None:
-        # jax 0.4.x: partial-manual shard_map over "pp" composed with auto
-        # (GSPMD) axes crashes the XLA CPU compiler on mixed meshes; only
-        # pure-pp meshes pipeline there. Mixed meshes keep the correct
-        # GSPMD layer-sharding path (just not pipelined).
+        # jax 0.4.x: partial-manual shard_map over the pipeline axes
+        # composed with auto (GSPMD) axes crashes the XLA CPU compiler on
+        # mixed meshes; only pure pp (and pp×sp — both manual) meshes
+        # pipeline there. Mixed meshes keep the correct GSPMD
+        # layer-sharding path (just not pipelined).
         other = 1
         for name, size in mesh.shape.items():
-            if name != "pp":
+            if name not in ("pp", "sp"):
                 other *= size
         if other > 1:
-            return None
+            return _fallback("old_jax_mixed_mesh")
     if requested is not None:
         n_micro = requested
         if batch % n_micro != 0:
-            return None
+            return _fallback("requested_indivisible")
         return n_micro
     # Auto: the largest divisor of the batch in [pp, 2*pp] — >= pp keeps
     # the bubble <= 1/2; > 2*pp only shrinks it further at more dispatch.
     for n_micro in range(min(2 * pp, batch), 0, -1):
         if batch % n_micro == 0 and n_micro >= pp:
             return n_micro
-    return None  # batch too small to feed every stage
+    return _fallback("batch_too_small")
+
+
+def pp_engagement(
+    mesh: Optional[Mesh],
+    cfg: TransformerConfig,
+    batch: int,
+    seq_len: int,
+    requested: Optional[int] = None,
+) -> Tuple[float, float]:
+    """(pp_engaged, ring_engaged) as 0/1 gauge values for this shape —
+    the same gates the forward path applies, evaluated outside the jit so
+    backend/jax_train.py can export ``train/pp_engaged`` /
+    ``train/ring_engaged`` without tracing anything."""
+    n_micro = pick_pp_microbatches(mesh, cfg, batch, requested,
+                                   seq_len=seq_len)
+    pp_on = n_micro is not None
+    if pp_on:
+        ring_on = mesh.shape.get("sp", 1) > 1
+    else:
+        ring_on = ring_mod.ring_eligible(mesh, cfg, batch, seq_len)
+    return float(pp_on), float(ring_on)
 
 
 def _scale_aux(aux: Dict[str, jnp.ndarray], cfg: TransformerConfig,
@@ -146,26 +209,79 @@ def pipeline_apply_layers(
 
     ``schedule`` selects the memory-bounded 1F1B custom-vjp path (default)
     or the GPipe scan oracle; ``AREAL_PP_SCHEDULE`` overrides the default.
+
+    PP∘SP: on meshes with sp > 1 the stages are manual over {"pp","sp"}
+    and run ring attention inline (ring_mod.ring_attention_inline). The
+    zig-zag ring layout is applied here — a static gather on the global
+    sequence dim, inverted on the way out — so the stage bodies see the
+    striped shard order while callers keep natural-order semantics.
     """
     if schedule is None:
         schedule = os.environ.get("AREAL_PP_SCHEDULE", "1f1b")
     if schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    sp = mesh.shape.get("sp", 1)
+    ring_schedule, inv = None, None
+    if sp > 1:
+        B, T, _ = h.shape
+        ring_schedule = ring_mod.resolve_schedule(None, T, sp, causal=True)
+        if segment_ids is None:
+            # The ring body masks by segment; "everything is one document"
+            # reproduces plain causal attention.
+            segment_ids = jnp.ones((B, T), jnp.int32)
+        if ring_schedule == "zigzag":
+            fwd_p = ring_mod.zigzag_permutation(T, sp)
+            inv = jnp.asarray(ring_mod.inverse_permutation(fwd_p))
+            fwd_p = jnp.asarray(fwd_p)
+            take = lambda x: None if x is None else jnp.take(x, fwd_p, axis=1)
+            h, cos, sin = take(h), take(cos), take(sin)
+            segment_ids, positions = take(segment_ids), take(positions)
     fn = _gpipe_apply_layers if schedule == "gpipe" else _1f1b_apply_layers
-    return fn(cfg, layer_params, h, cos, sin, segment_ids, positions,
-              mesh, n_micro, attn_impl, remat)
+    # Inside a manual-{"pp","sp"} region a with_sharding_constraint must
+    # not name the manual axes — push rules with them stripped for the
+    # duration of the (trace-time) stage bodies.
+    ctx = (psh.activation_sharding(mesh, psh.rules_without_axes(("pp", "sp")))
+           if sp > 1 else nullcontext())
+    with ctx:
+        out, aux = fn(cfg, layer_params, h, cos, sin, segment_ids, positions,
+                      mesh, n_micro, attn_impl, remat, ring_schedule)
+    if inv is not None:
+        out = jnp.take(out, inv, axis=1)
+    return out, aux
 
 
 # ---------------- GPipe scan (the parity oracle) ----------------
 
 
+def _stage_specs(layer_params, sp_manual):
+    """(manual_axes, in_spec pieces) shared by the three shard_maps: the
+    stage iota, ring iota, layer stack, [n_micro, mb, T, ...] activations
+    and [n_micro, mb, T] token arrays. With sp manual the sequence dim
+    shards over the ring; otherwise the specs are exactly the pp-only
+    originals."""
+    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    if sp_manual:
+        return ({"pp", "sp"}, P("sp"), layer_specs,
+                P(None, None, "sp", None), P(None, None, "sp"))
+    return ({"pp"}, P(), layer_specs, P(), P())
+
+
+def _ring_ctx(ring_arr, sp, ring_schedule):
+    """RingCtx from the P("sp")-sharded iota (None when sp is not manual);
+    see ring_mod.RingCtx for why the rank can't come from axis_index."""
+    if sp <= 1:
+        return None
+    return ring_mod.RingCtx("sp", sp, ring_arr[0], ring_schedule)
+
+
 def _gpipe_apply_layers(
     cfg, layer_params, h, cos, sin, segment_ids, positions,
-    mesh, n_micro, attn_impl, remat,
+    mesh, n_micro, attn_impl, remat, ring_schedule=None,
 ):
     from areal_tpu.models import transformer as tfm
 
     pp = mesh.shape["pp"]
+    sp = mesh.shape.get("sp", 1)
     B, T, D = h.shape
     assert B % n_micro == 0 and cfg.n_layers % pp == 0
     mb = B // n_micro
@@ -179,14 +295,16 @@ def _gpipe_apply_layers(
     seg_mbs = to_mbs(segment_ids)
     pos_mbs = to_mbs(positions)
 
-    def stage_body(stage_arr, local_layers, h_mbs, cos_mbs, sin_mbs,
-                   seg_mbs, pos_mbs):
+    def stage_body(stage_arr, ring_arr, local_layers, h_mbs, cos_mbs,
+                   sin_mbs, seg_mbs, pos_mbs):
         # Stage id arrives as a P("pp")-sharded iota rather than
         # jax.lax.axis_index: under partial-manual shard_map on older jax
         # the latter lowers to a PartitionId instruction the SPMD
         # partitioner rejects when auto axes are present.
         stage = stage_arr[0]
+        ring_ctx = _ring_ctx(ring_arr, sp, ring_schedule)
         fwd_perm = [(k, k + 1) for k in range(pp - 1)]
+        Tl = h_mbs.shape[2]  # local sequence shard (T/sp when sp manual)
 
         def step(carry, s):
             state, aux_acc = carry
@@ -204,7 +322,7 @@ def _gpipe_apply_layers(
             y, aux = tfm.apply_layer_stack(
                 cfg, x, local_layers, take(cos_mbs), take(sin_mbs),
                 take(seg_mbs), take(pos_mbs), attn_impl=attn_impl,
-                remat=remat, allow_ring=False,
+                remat=remat, allow_ring=True, ring_ctx=ring_ctx,
             )
             # Bubble steps run garbage (their ys are never sliced out);
             # MoE aux must not count them.
@@ -219,12 +337,13 @@ def _gpipe_apply_layers(
             return (state, aux_acc), y
 
         aux0 = {k: jnp.zeros((), jnp.float32) for k in _aux_keys(cfg)}
-        state0 = jnp.zeros((mb, T, D), h_mbs.dtype)
+        state0 = jnp.zeros((mb, Tl, D), h_mbs.dtype)
         (_, aux_acc), ys = jax.lax.scan(
             step, (state0, aux0), jnp.arange(steps)
         )
         aux_out = {
-            k: jax.lax.psum(v, "pp") for k, v in aux_acc.items()
+            k: jax.lax.psum(v, ("pp", "sp") if sp > 1 else "pp")
+            for k, v in aux_acc.items()
         }
         # KNOWN COST (why this schedule is only the oracle): ys stacks each
         # stage's per-step outputs ([steps, mb, T, D] per device ≈
@@ -233,19 +352,22 @@ def _gpipe_apply_layers(
         # for all ``steps`` iterations. The 1F1B path below fixes both.
         return ys, aux_out
 
-    # Manual over "pp" ONLY: layer stacks arrive as local [L/pp, ...]
-    # slices; activations stay full-shaped with dp/fsdp/tp handled by
-    # GSPMD inside each stage.
-    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
-    n_opt = 4  # cos/sin/segs/pos
+    # Manual over the pipeline axes only: layer stacks arrive as local
+    # [L/pp, ...] slices (and activations as T/sp sequence shards when sp
+    # rings); dp/fsdp/tp inside each stage stay automatic (GSPMD).
+    manual, iota_spec, layer_specs, act_spec, tok_spec = _stage_specs(
+        layer_params, sp > 1
+    )
+    ys_spec = P("pp", None, "sp", None) if sp > 1 else P("pp")
     ys, aux = shard_map(
         stage_body,
         mesh=mesh,
-        in_specs=(P("pp"), layer_specs, P()) + (P(),) * n_opt,
-        out_specs=(P("pp"), P()),
-        axis_names={"pp"},
-    )(jnp.arange(pp, dtype=jnp.int32), layer_params, h_mbs, cos_mbs,
-      sin_mbs, seg_mbs, pos_mbs)
+        in_specs=(P("pp"), iota_spec, layer_specs, act_spec, act_spec,
+                  act_spec, tok_spec, tok_spec),
+        out_specs=(ys_spec, P()),
+        axis_names=manual,
+    )(jnp.arange(pp, dtype=jnp.int32), jnp.arange(sp, dtype=jnp.int32),
+      layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs)
 
     # ys is the per-stage step outputs concatenated over "pp":
     # [pp*steps, mb, T, D]; the finished micro-batch i left the LAST stage
@@ -270,13 +392,22 @@ def _make_stage_fn(cfg, attn_impl, remat):
     any drift between the two would break gradient parity silently, so
     there is exactly one definition."""
 
-    def stage_fn(local_layers, x, cos_j, sin_j, seg_j, pos_j):
+    def stage_fn(local_layers, x, cos_j, sin_j, seg_j, pos_j,
+                 ring_ctx=None):
         from areal_tpu.models import transformer as tfm
 
-        y, aux = tfm.apply_layer_stack(
-            cfg, x, local_layers, cos_j, sin_j, seg_j, pos_j,
-            attn_impl=attn_impl, remat=remat, allow_ring=False,
-        )
+        # Stage bodies trace inside a shard_map manual over {"pp"} or
+        # {"pp","sp"}, but the trace POINT varies: the 1F1B custom-vjp
+        # backward traces after pipeline_apply_layers' stripped-rules
+        # context has popped, leaving whatever outer activation_sharding
+        # the engine holds (full rules naming "sp") innermost — strip the
+        # manual axes here, at the constrain calls themselves.
+        with psh.strip_manual_axes(("pp", "sp")):
+            y, aux = tfm.apply_layer_stack(
+                cfg, x, local_layers, cos_j, sin_j, seg_j, pos_j,
+                attn_impl=attn_impl, remat=remat, allow_ring=True,
+                ring_ctx=ring_ctx,
+            )
         aux_sums = {k: jnp.sum(aux[k].astype(jnp.float32)) for k in aux} \
             if aux else {}
         return y, aux_sums
@@ -285,24 +416,27 @@ def _make_stage_fn(cfg, attn_impl, remat):
 
 
 def _1f1b_parts(cfg, mesh, n_micro, attn_impl, remat,
-                layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs):
+                layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs,
+                ring_schedule=None):
     """The 1F1B forward: returns (out_blocks, aux, saved_x) where
     ``saved_x`` — each stage's n_micro micro-batch INPUTS, ``[pp*n_micro,
     mb, T, D]`` sharded P("pp") — is the complete activation residual set
-    the backward needs (everything else is rematerialized per stage-step).
-    ``out_blocks`` is per-stage output buffers concatenated over "pp"; only
-    the last stage's block carries the pipeline output."""
+    the backward needs (everything else is rematerialized per stage-step;
+    under PP∘SP that includes the stage's ring steps)."""
     pp = mesh.shape["pp"]
+    sp = mesh.shape.get("sp", 1)
     n_micro_, mb, T, D = h_mbs.shape
     assert n_micro_ == n_micro
     steps = n_micro + pp - 1
     aux_keys = _aux_keys(cfg)
     stage_fn = _make_stage_fn(cfg, attn_impl, remat)
 
-    def fwd_body(stage_arr, local_layers, h_mbs, cos_mbs, sin_mbs,
-                 seg_mbs, pos_mbs):
+    def fwd_body(stage_arr, ring_arr, local_layers, h_mbs, cos_mbs,
+                 sin_mbs, seg_mbs, pos_mbs):
         stage = stage_arr[0]  # P("pp") iota; see _gpipe stage_body note
+        ring_ctx = _ring_ctx(ring_arr, sp, ring_schedule)
         fwd_perm = [(k, k + 1) for k in range(pp - 1)]
+        Tl = h_mbs.shape[2]
 
         def step(carry, s):
             state, aux_acc, saved_x, out_buf = carry
@@ -326,7 +460,7 @@ def _1f1b_parts(cfg, mesh, n_micro, attn_impl, remat,
             )
             y, aux_sums = stage_fn(local_layers, x, take(cos_mbs),
                                    take(sin_mbs), take(seg_mbs),
-                                   take(pos_mbs))
+                                   take(pos_mbs), ring_ctx)
             vf = valid.astype(jnp.float32)
             aux_acc = {
                 k: aux_acc[k] + vf * aux_sums[k] for k in aux_acc
@@ -342,43 +476,52 @@ def _1f1b_parts(cfg, mesh, n_micro, attn_impl, remat,
             return (state, aux_acc, saved_x, out_buf), None
 
         aux0 = {k: jnp.zeros((), jnp.float32) for k in aux_keys}
-        state0 = jnp.zeros((mb, T, D), h_mbs.dtype)
-        saved0 = jnp.zeros((n_micro, mb, T, D), h_mbs.dtype)
-        out0 = jnp.zeros((n_micro, mb, T, D), h_mbs.dtype)
+        state0 = jnp.zeros((mb, Tl, D), h_mbs.dtype)
+        saved0 = jnp.zeros((n_micro, mb, Tl, D), h_mbs.dtype)
+        out0 = jnp.zeros((n_micro, mb, Tl, D), h_mbs.dtype)
         (_, aux_acc, saved_x, out_buf), _ = jax.lax.scan(
             step, (state0, aux0, saved0, out0), jnp.arange(steps)
         )
-        aux_out = {k: jax.lax.psum(v, "pp") for k, v in aux_acc.items()}
+        aux_out = {k: jax.lax.psum(v, ("pp", "sp") if sp > 1 else "pp")
+                   for k, v in aux_acc.items()}
         return out_buf, aux_out, saved_x
 
-    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    manual, iota_spec, layer_specs, act_spec, tok_spec = _stage_specs(
+        layer_params, sp > 1
+    )
+    buf_spec = P("pp", None, "sp", None) if sp > 1 else P("pp")
     return shard_map(
         fwd_body,
         mesh=mesh,
-        in_specs=(P("pp"), layer_specs, P()) + (P(),) * 4,
-        out_specs=(P("pp"), P(), P("pp")),
-        axis_names={"pp"},
-    )(jnp.arange(pp, dtype=jnp.int32), layer_params, h_mbs, cos_mbs,
-      sin_mbs, seg_mbs, pos_mbs)
+        in_specs=(P("pp"), iota_spec, layer_specs, act_spec, act_spec,
+                  act_spec, tok_spec, tok_spec),
+        out_specs=(buf_spec, P(), buf_spec),
+        axis_names=manual,
+    )(jnp.arange(pp, dtype=jnp.int32), jnp.arange(sp, dtype=jnp.int32),
+      layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs)
 
 
 def _1f1b_bwd_impl(cfg, mesh, n_micro, attn_impl, remat,
                    layer_params, saved_x, cos_mbs, sin_mbs, seg_mbs,
-                   pos_mbs, d_out, d_aux):
+                   pos_mbs, d_out, d_aux, ring_schedule=None):
     """Hand-written reverse pipeline: at backward step ``t`` stage ``k``
     rematerializes micro-batch ``j = t + k - (pp-1)`` from its saved input
-    and vjp's it; the input-cotangent rides the transposed ppermute to the
-    predecessor while param-cotangents accumulate in place."""
+    and vjp's it (under PP∘SP the re-run includes the stage's ring steps —
+    ppermute has a transpose rule, so the vjp is exact); the
+    input-cotangent rides the transposed ppermute to the predecessor while
+    param-cotangents accumulate in place."""
     pp = mesh.shape["pp"]
-    _, mb, T, D = saved_x.shape[-4:]
+    sp = mesh.shape.get("sp", 1)
     steps = n_micro + pp - 1
     aux_keys = _aux_keys(cfg)
     stage_fn = _make_stage_fn(cfg, attn_impl, remat)
 
-    def bwd_body(stage_arr, local_layers, saved_x, cos_mbs, sin_mbs,
-                 seg_mbs, pos_mbs, d_out, d_aux):
+    def bwd_body(stage_arr, ring_arr, local_layers, saved_x, cos_mbs,
+                 sin_mbs, seg_mbs, pos_mbs, d_out, d_aux):
         stage = stage_arr[0]  # P("pp") iota; see _gpipe stage_body note
+        ring_ctx = _ring_ctx(ring_arr, sp, ring_schedule)
         bwd_perm = [(k, k - 1) for k in range(1, pp)]
+        _, mb, Tl, D = saved_x.shape
 
         def step(carry, t):
             dstate, dtheta, d_h_buf = carry
@@ -400,7 +543,8 @@ def _1f1b_bwd_impl(cfg, mesh, n_micro, attn_impl, remat,
             dy = jnp.where(valid, dy, jnp.zeros_like(dy))
             cos_j, sin_j, seg_j, pos_j = (take(cos_mbs), take(sin_mbs),
                                           take(seg_mbs), take(pos_mbs))
-            fn = lambda p, xx: stage_fn(p, xx, cos_j, sin_j, seg_j, pos_j)
+            fn = lambda p, xx: stage_fn(p, xx, cos_j, sin_j, seg_j, pos_j,
+                                        ring_ctx)
             _, vjp_fn = jax.vjp(fn, local_layers, x)
             vf = valid.astype(jnp.float32)
             d_aux_t = {k: d_aux[k].astype(jnp.float32) * vf
@@ -420,24 +564,36 @@ def _1f1b_bwd_impl(cfg, mesh, n_micro, attn_impl, remat,
             dstate = jax.lax.ppermute(dx, "pp", bwd_perm)
             return (dstate, dtheta, d_h_buf), None
 
-        dstate0 = jnp.zeros((mb, T, D), saved_x.dtype)
+        dstate0 = jnp.zeros((mb, Tl, D), saved_x.dtype)
         dtheta0 = jax.tree.map(jnp.zeros_like, local_layers)
-        dh0 = jnp.zeros((n_micro, mb, T, D), saved_x.dtype)
+        dh0 = jnp.zeros((n_micro, mb, Tl, D), saved_x.dtype)
         (_, dtheta, d_h_buf), _ = jax.lax.scan(
             step, (dstate0, dtheta0, dh0), jnp.arange(steps)
         )
+        if sp > 1:
+            # Layer params are replicated over the ring: each sp shard's
+            # dtheta covers only its sequence shard's tokens — the total
+            # is their sum. This backward is hand-written (no shard_map
+            # transpose runs), so the psum must be explicit here.
+            dtheta = jax.tree.map(
+                lambda g: jax.lax.psum(g, "sp"), dtheta
+            )
         return dtheta, d_h_buf
 
-    layer_specs = jax.tree.map(lambda _: P("pp"), layer_params)
+    manual, iota_spec, layer_specs, act_spec, tok_spec = _stage_specs(
+        layer_params, sp > 1
+    )
+    buf_spec = P("pp", None, "sp", None) if sp > 1 else P("pp")
     d_layers, d_h_blocks = shard_map(
         bwd_body,
         mesh=mesh,
-        in_specs=(P("pp"), layer_specs, P("pp")) + (P(),) * 4
-        + (P("pp"), P()),
-        out_specs=(P("pp"), P("pp")),
-        axis_names={"pp"},
-    )(jnp.arange(pp, dtype=jnp.int32), layer_params, saved_x, cos_mbs,
-      sin_mbs, seg_mbs, pos_mbs, d_out, d_aux)
+        in_specs=(P("pp"), iota_spec, layer_specs, buf_spec, act_spec,
+                  act_spec, tok_spec, tok_spec, buf_spec, P()),
+        out_specs=(P("pp"), buf_spec),
+        axis_names=manual,
+    )(jnp.arange(pp, dtype=jnp.int32), jnp.arange(sp, dtype=jnp.int32),
+      layer_params, saved_x, cos_mbs, sin_mbs, seg_mbs, pos_mbs, d_out,
+      d_aux)
     # d_h_blocks concatenates per-stage buffers over "pp"; only stage 0
     # ingests h, so its block (the first) is the input cotangent — a lazy
     # slice, no collective.
@@ -457,7 +613,7 @@ def _zero_cotangent(x):
 
 def _1f1b_apply_layers(
     cfg, layer_params, h, cos, sin, segment_ids, positions,
-    mesh, n_micro, attn_impl, remat,
+    mesh, n_micro, attn_impl, remat, ring_schedule=None,
 ):
     pp = mesh.shape["pp"]
     B, T, D = h.shape
@@ -472,6 +628,7 @@ def _1f1b_apply_layers(
         out, aux, _ = _1f1b_parts(
             cfg, mesh, n_micro, attn_impl, remat,
             layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs,
+            ring_schedule,
         )
         return out, aux
 
@@ -479,6 +636,7 @@ def _1f1b_apply_layers(
         out, aux, saved_x = _1f1b_parts(
             cfg, mesh, n_micro, attn_impl, remat,
             layer_params, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs,
+            ring_schedule,
         )
         res = (layer_params, saved_x, cos_mbs, sin_mbs, seg_mbs, pos_mbs)
         return (out, aux), res
@@ -489,7 +647,7 @@ def _1f1b_apply_layers(
         d_layers, d_h_mbs = _1f1b_bwd_impl(
             cfg, mesh, n_micro, attn_impl, remat,
             layer_params, saved_x, cos_mbs, sin_mbs, seg_mbs, pos_mbs,
-            d_out, d_aux,
+            d_out, d_aux, ring_schedule,
         )
         return (d_layers, d_h_mbs, _zero_cotangent(cos_mbs),
                 _zero_cotangent(sin_mbs), _zero_cotangent(seg_mbs),
@@ -531,8 +689,14 @@ def backward_residual_bytes(
     ``>= (steps / n_micro)`` times this number; tests assert the scaling.
     """
     pp = mesh.shape["pp"]
+    sp = mesh.shape.get("sp", 1)
     B = h.shape[0]
     mb = B // n_micro
+    ring_schedule = (
+        ring_mod.resolve_schedule(None, h.shape[1], sp) if sp > 1 else None
+    )
+    if sp > 1 and segment_ids is None:
+        segment_ids = jnp.ones(h.shape[:2], jnp.int32)
 
     def to_mbs(x):
         return x.reshape((n_micro, mb) + x.shape[1:]) if x is not None else None
@@ -540,7 +704,7 @@ def backward_residual_bytes(
     def fwd(lp, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs):
         _, _, saved_x = _1f1b_parts(
             cfg, mesh, n_micro, attn_impl, remat,
-            lp, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs,
+            lp, h_mbs, cos_mbs, sin_mbs, seg_mbs, pos_mbs, ring_schedule,
         )
         return saved_x
 

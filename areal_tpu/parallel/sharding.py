@@ -131,6 +131,44 @@ ACTIVATION_RULES: Dict[str, P] = {
     "logits_decode": P(DATA_AXES, None, "tp"),
 }
 
+def rules_without_axes(axes, rules: Optional[Dict[str, P]] = None
+                       ) -> Dict[str, P]:
+    """ACTIVATION_RULES with the given mesh axes stripped from every spec
+    — for code traced inside a shard_map that is manual over ``axes``
+    (parallel/pipeline.py's PP∘SP stages), where a
+    with_sharding_constraint naming a manual axis is an error. Tuple
+    entries drop the stripped members; entries that become empty turn into
+    None."""
+    axes = frozenset(axes)
+    out: Dict[str, P] = {}
+    for kind, spec in (rules or ACTIVATION_RULES).items():
+        parts = []
+        for p in spec:
+            if isinstance(p, (tuple, list)):
+                kept = tuple(a for a in p if a not in axes)
+                parts.append(kept if kept else None)
+            else:
+                parts.append(None if p in axes else p)
+        out[kind] = P(*parts)
+    return out
+
+
+@contextmanager
+def strip_manual_axes(axes):
+    """Re-push the innermost activation_sharding context with ``axes``
+    stripped from every rule (no-op when no context is active). For code
+    traced inside a shard_map manual over ``axes`` whose trace point is
+    NOT lexically inside the caller's own stripped-rules push — e.g. a
+    custom_vjp backward traced long after the forward's context popped,
+    with only the engine's full-rules context left on the stack."""
+    if not _ACTIVE:
+        yield
+        return
+    mesh, rules = _ACTIVE[-1]
+    with activation_sharding(mesh, rules_without_axes(axes, rules)):
+        yield
+
+
 _ACTIVE: list = []  # stack of (mesh, rules)
 
 

@@ -107,6 +107,11 @@ METRIC_CATALOG = frozenset({
     "train/loss_weight", "train/total_tokens",
     # train engine counters/gauges (backend/jax_train.py)
     "train/tokens", "train/optimizer_steps", "train/pack_fill",
+    # parallelism engagement (parallel/pipeline.py gates, exported per
+    # batch by backend/jax_train.py): 0/1 gauges for whether the pipeline
+    # schedule and ring attention actually engaged, plus the per-reason
+    # GSPMD-fallback counter.
+    "train/pp_engaged", "train/ring_engaged", "parallel/pp_fallback",
     # goodput ledger + live MFU (system/goodput.py): per-worker
     # time-in-state counters, the trainer's achieved-FLOP/s gauges, the
     # generation servers' analytic decode/prefill FLOP/s, and the

@@ -290,6 +290,52 @@ def main():
     spool_append_ms = spool_append_s / len(frames) * 1e3
     spool_ack_ms = spool_ack_s / len(frames) * 1e3
 
+    # Long-context ring-attention row (ISSUE 18): one attention layer's
+    # fwd+bwd step time at long context under the active ring schedule
+    # (zig-zag + causal-skip + double-buffered ppermute) vs the contiguous
+    # v1 oracle (AREAL_RING_SCHEDULE=naive), on an sp=<all local chips>
+    # ring. The skip ratio comes from the trace-time area counters
+    # (parallel/ring.py), so it is structural — (n+1)/2n at sp=n — not a
+    # timing artifact. On one chip the ring is degenerate (sp=1, both
+    # schedules identical); the fields still emit so the BENCH trajectory
+    # has the row, and `perf_probe ring-bench` sweeps the multi-shard
+    # shapes on host devices. See docs/benchmarks.md for the method note.
+    from areal_tpu.parallel import mesh as pmesh_mod
+    from areal_tpu.parallel import ring as ring_mod
+
+    ring_sp = n_chips
+    ring_seq = 4096
+    ring_mesh = pmesh_mod.make_mesh(pmesh_mod.ParallelSpec(sp=ring_sp))
+    rngr = np.random.RandomState(0)
+    rq = jnp.asarray(rngr.randn(1, ring_seq, cfg.n_q_heads, cfg.head_dim)
+                     .astype(np.float32) * 0.1)
+    rk = jnp.asarray(rngr.randn(1, ring_seq, cfg.n_kv_heads, cfg.head_dim)
+                     .astype(np.float32) * 0.1)
+    rv = jnp.asarray(rngr.randn(1, ring_seq, cfg.n_kv_heads, cfg.head_dim)
+                     .astype(np.float32) * 0.1)
+    rseg = jnp.ones((1, ring_seq), jnp.int32)
+
+    def ring_step_time(schedule):
+        def loss(q, k, v):
+            o = ring_mod.ring_attention(q, k, v, rseg, ring_mesh,
+                                        schedule=schedule)
+            return jnp.sum(o * o)
+
+        f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        ring_mod.reset_ring_counters()
+        jax.block_until_ready(f(rq, rk, rv))  # compile; fills counters
+        ratio = ring_mod.ring_skip_ratio()
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            g = f(rq, rk, rv)
+        jax.block_until_ready(g)
+        return (time.perf_counter() - t0) / reps * 1e3, ratio
+
+    ring_sched = ring_mod.resolve_schedule(None, ring_seq, ring_sp)
+    ring_step_ms, ring_skip = ring_step_time(ring_sched)
+    ring_naive_step_ms, _ = ring_step_time("naive")
+
     # Roofline context over the bf16 peak of one chip. The 6·N·T train
     # FLOPs estimate and the per-generation peak table live in
     # base/monitor.py — ONE accounting shared with the live trainer's
@@ -314,6 +360,14 @@ def main():
         "weight_sync_device_s": round(weight_sync_device_s, 3),
         "spool_append_ms": round(spool_append_ms, 3),
         "spool_ack_ms": round(spool_ack_ms, 3),
+        "ring_seq_len": ring_seq,
+        "ring_sp": ring_sp,
+        "ring_step_ms": round(ring_step_ms, 3),
+        "ring_naive_step_ms": round(ring_naive_step_ms, 3),
+        "ring_skip_ratio": round(ring_skip, 4),
+        # Discontinuity key for the ring_* fields (bench_compare skips
+        # them when the schedule method changes, like weight_sync_*).
+        "ring_schedule_method": f"{ring_sched}-sp{ring_sp}",
         # METHOD CHANGE vs r6: the device transport (on-device reshard
         # publish + digest-gated consume) is measured ALONGSIDE the
         # streamed path — weight_sync_latency_s still names the streamed

@@ -193,6 +193,44 @@ def test_train_batch_reduces_loss(mesh_spec):
     assert eng.opt_step_count == 8
 
 
+@pytest.mark.ring
+def test_train_batch_ppsp_matches_dense():
+    """Step-0 train_batch parity, PP∘SP (p2s2) vs dense.
+
+    Regression pin for the sp-sharded loss miscompile: jax 0.4.x GSPMD
+    summed per-shard partials of a next-token-shift concatenate along an
+    sp-sharded dim, so on pp×sp meshes the CE mask came back doubled and
+    every position invalid (loss -0.0, n_valid 0). The engine now keeps
+    the sequence dim unsharded outside manual regions; this test fails
+    if that regresses.
+    """
+    rng = np.random.RandomState(1)
+    cfg = tiny_config(vocab_size=64)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    # 8 seqs of exactly 16 tokens -> packer picks [R=8, L=16]: pp=2
+    # engages (8 % 2 == 0) and ring engages (16 % 2*sp == 0).
+    s = _sample(rng, 8, minlen=16, maxlen=17)
+    spec = MicroBatchSpec(max_tokens_per_mb=128)
+    stats = {}
+    for label, mesh_spec in [("p2s2", "p2s2"), (None, None)]:
+        mesh = (pmesh.make_mesh(pmesh.ParallelSpec.parse(mesh_spec))
+                if mesh_spec else None)
+        eng = JaxTrainEngine(
+            cfg, jax.tree.map(jnp.copy, params),  # train_batch donates
+            opt_cfg=OptimizerConfig(lr=1e-2, lr_scheduler_type="constant",
+                                    warmup_steps_proportion=0.0),
+            ft_spec=FinetuneSpec(1, 64, 8),
+            mesh=mesh, compute_dtype="float32",
+            length_bucket=16, rows_bucket=4,
+        )
+        stats[label] = eng.train_batch(s, spec, _ce_loss, _weight)
+    assert stats["p2s2"]["n_valid"] == stats[None]["n_valid"] > 0
+    np.testing.assert_allclose(stats["p2s2"]["loss"], stats[None]["loss"],
+                               rtol=1e-4)
+    np.testing.assert_allclose(stats["p2s2"]["grad_norm"],
+                               stats[None]["grad_norm"], rtol=1e-3)
+
+
 def test_forward_logprobs_match_direct():
     rng = np.random.RandomState(2)
     cfg = tiny_config(vocab_size=32)

@@ -163,6 +163,26 @@ def test_autoscale_config_validates_at_parse_time():
     CA.validate_config(PPOMATHConfig())
 
 
+def test_generation_sp_fails_at_config_parse_time():
+    """ISSUE 18 satellite: the decode hot loop never rings
+    (allow_ring=False on the decode path), so sp>1 in a generation-side
+    allocation spec must fail at parse time with guidance — not surface
+    as silently replicated work at server launch."""
+    for bad in ("gen.s2d2+d2f2t2", "actor_gen:s2t2,actor_train:p2s2"):
+        cfg = AsyncPPOMATHConfig()
+        CA.apply_overrides(cfg, [
+            "n_nodes=1", "n_gpus_per_node=8", f"allocation_mode={bad}",
+        ])
+        with pytest.raises(CA.ConfigError, match="never rings"):
+            CA.validate_config(cfg)
+    # sp on the TRAIN side is the PP∘SP path and validates clean
+    cfg = AsyncPPOMATHConfig()
+    CA.apply_overrides(cfg, [
+        "n_nodes=1", "n_gpus_per_node=8", "allocation_mode=gen.d4+p2s2",
+    ])
+    CA.validate_config(cfg)
+
+
 def test_invalid_serving_buckets_fail_at_config_parse_time():
     """Serving bucket configs that would crash every spawned generation
     server's __init__ (row_buckets below the batch size, shape sets over

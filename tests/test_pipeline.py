@@ -51,9 +51,19 @@ def test_pick_pp_microbatches_gates():
     # layers must divide across stages
     cfg3 = tiny_config(n_layers=3)
     assert ppl.pick_pp_microbatches(m, cfg3, 8) is None
-    # sp meshes fall back to GSPMD layer sharding
-    msp = pmesh.make_mesh(pmesh.ParallelSpec.parse("p2s2t2"))
+    # sp meshes pipeline too (PP∘SP) — when the sequence shards over the
+    # ring; without a seq_len (or with an indivisible one) they fall back
+    msp = pmesh.make_mesh(pmesh.ParallelSpec.parse("p2s2"))
     assert ppl.pick_pp_microbatches(msp, cfg, 8) is None
+    assert ppl.pick_pp_microbatches(msp, cfg, 8, seq_len=31) is None
+    assert ppl.pick_pp_microbatches(msp, cfg, 8, seq_len=32) == 4
+    # ... pure pp×sp pipelines on every jax; mixing in auto axes needs
+    # jax.shard_map (same old-jax gate as d2p2t2 above)
+    mspt = pmesh.make_mesh(pmesh.ParallelSpec.parse("p2s2t2"))
+    if mixed_ok:
+        assert ppl.pick_pp_microbatches(mspt, cfg, 8, seq_len=32) == 4
+    else:
+        assert ppl.pick_pp_microbatches(mspt, cfg, 8, seq_len=32) is None
     # no pp axis
     mnp = pmesh.make_mesh(pmesh.ParallelSpec.parse("d2f2t2"))
     assert ppl.pick_pp_microbatches(mnp, cfg, 8) is None
@@ -324,6 +334,87 @@ def test_1f1b_backward_residuals_scale_with_n_micro():
         assert gpipe / one_f1b >= 1 + (pp - 1) / n_micro
     # Doubling n_micro at fixed B keeps the residual set pinned at B rows:
     assert measure("p2", B, T, n_micro=8) == expected
+
+
+@pytest.mark.ring
+@pytest.mark.parametrize("ring_schedule", ["zigzag", "naive"])
+@pytest.mark.parametrize("sched", ["1f1b", "gpipe"])
+def test_ppsp_matches_gspmd_oracle(sched, ring_schedule, monkeypatch):
+    """PP∘SP e2e parity: on a pp×sp CPU mesh both pipeline schedules, with
+    ring attention running inside each stage (both ring schedules), must
+    reproduce the dense scan oracle's loss AND gradients at the existing
+    pipeline parity tolerances."""
+    monkeypatch.setenv("AREAL_RING_SCHEDULE", ring_schedule)
+    cfg = tiny_config(n_layers=4, hidden_dim=32, n_q_heads=4, n_kv_heads=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(7))
+    tokens, positions, seg = _batch(cfg, seed=7)
+    m = pmesh.make_mesh(pmesh.ParallelSpec.parse("p2s2"))
+    assert ppl.pick_pp_microbatches(m, cfg, tokens.shape[0],
+                                    seq_len=tokens.shape[1]) is not None
+    sp = psh.shard_params(params, m, cfg)
+    mask = (jnp.asarray(seg) > 0).astype(jnp.float32)
+
+    def dense_loss(p):
+        h = p["embedding"][jnp.asarray(tokens)]
+        cos, sin = transformer.rope_tables(
+            jnp.asarray(positions), cfg.head_dim, cfg.rotary_base
+        )
+        out, _ = transformer.apply_layer_stack(
+            cfg, h, p["layers"], cos, sin, jnp.asarray(seg),
+            jnp.asarray(positions),
+        )
+        return jnp.sum(
+            jnp.tanh(out.astype(jnp.float32)) ** 2 * mask[..., None]
+        )
+
+    def pp_loss(p):
+        with psh.activation_sharding(m):
+            out, _ = _pipeline_call(
+                cfg, p, (tokens, positions, seg), m, 2, sched
+            )
+        return jnp.sum(
+            jnp.tanh(out.astype(jnp.float32)) ** 2 * mask[..., None]
+        )
+
+    v_ref, g_ref = jax.jit(jax.value_and_grad(dense_loss))(params)
+    v_pp, g_pp = jax.jit(jax.value_and_grad(pp_loss))(sp)
+    np.testing.assert_allclose(float(v_pp), float(v_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pp)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=1e-3
+        )
+
+
+@pytest.mark.ring
+def test_backward_residuals_invariant_to_sp():
+    """PP∘SP must not change the 1F1B residual accounting: the per-stage
+    saved set is the same n_micro GLOBAL micro-batch inputs whether or not
+    the sequence dim shards over a ring (each sp shard holds 1/sp of it,
+    but the metric counts the reassembled global buffer)."""
+    cfg = tiny_config(n_layers=4, hidden_dim=32, n_q_heads=4, n_kv_heads=2)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(6))
+    B, T = 8, 16
+
+    def measure(spec):
+        m = pmesh.make_mesh(pmesh.ParallelSpec.parse(spec))
+        sp = psh.shard_params(params, m, cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+        positions = np.tile(np.arange(T, dtype=np.int32), (B, 1))
+        seg = np.ones((B, T), np.int32)
+        h = params["embedding"][jnp.asarray(tokens)]
+        cos, sin = transformer.rope_tables(
+            jnp.asarray(positions), cfg.head_dim, cfg.rotary_base
+        )
+        return ppl.backward_residual_bytes(
+            cfg, sp["layers"], h, cos, sin, jnp.asarray(seg),
+            jnp.asarray(positions), m, n_micro=4,
+        )
+
+    base = measure("p2")
+    assert base == B * T * cfg.hidden_dim * 4  # f32 stage inputs
+    assert measure("p2s2") == base
+    assert measure("p2s4") == base
 
 
 def test_pipeline_moe_aux_parity():

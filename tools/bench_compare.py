@@ -22,6 +22,9 @@ with per-field relative tolerances:
   weight_sync_device_s       lower      25%
   spool_append_ms            lower      50%
   spool_ack_ms               lower      50%
+  ring_step_ms               lower      25%
+  ring_naive_step_ms         lower      25%
+  ring_skip_ratio            lower      0% (structural — must not grow)
   train_phases.*             lower      25%
 
 Exit status 0 when every comparable field is within tolerance, 1 on any
@@ -35,7 +38,9 @@ Caveats the gate understands:
  - when ``weight_sync_transport_method`` differs between the two gated
    rounds, every ``weight_sync_*`` field is skipped — the numbers
    measure different things across a method discontinuity
-   (docs/benchmarks.md "Reading the numbers across rounds").
+   (docs/benchmarks.md "Reading the numbers across rounds");
+ - likewise when ``ring_schedule_method`` differs (ring schedule or sp
+   width changed), every ``ring_*`` field is skipped.
 
 ``--tol field=frac`` overrides a tolerance (e.g. ``--tol value=0.10``,
 ``--tol train_phases.fwd_bwd_s=0.5``); ``--tol default=frac`` sets the
@@ -63,9 +68,18 @@ FIELDS: Dict[str, Tuple[str, float]] = {
     # shared CI disks; docs/fault_tolerance.md §Data durability).
     "spool_append_ms": ("lower", 0.50),
     "spool_ack_ms": ("lower", 0.50),
+    # Long-context ring attention (ISSUE 18): one attention layer's
+    # fwd+bwd step time at the bench's long-context shape, active schedule
+    # vs the contiguous oracle, plus the structural causal-skip ratio
+    # ((n+1)/2n at sp=n — lower means more skipped work). Skipped across
+    # a ring_schedule_method discontinuity like weight_sync_*.
+    "ring_step_ms": ("lower", 0.25),
+    "ring_naive_step_ms": ("lower", 0.25),
+    "ring_skip_ratio": ("lower", 0.0),
 }
 TRAIN_PHASE_SPEC = ("lower", 0.25)
 METHOD_FIELD = "weight_sync_transport_method"
+RING_METHOD_FIELD = "ring_schedule_method"
 
 
 def load_bench(path: str) -> Dict[str, object]:
@@ -111,6 +125,11 @@ def compare(prev: Dict[str, object], cur: Dict[str, object],
         and cur.get(METHOD_FIELD) is not None
         and prev.get(METHOD_FIELD) != cur.get(METHOD_FIELD)
     )
+    ring_method_changed = (
+        prev.get(RING_METHOD_FIELD) is not None
+        and cur.get(RING_METHOD_FIELD) is not None
+        and prev.get(RING_METHOD_FIELD) != cur.get(RING_METHOD_FIELD)
+    )
     rows: List[Dict[str, object]] = []
     for field in sorted(set(prev) | set(cur)):
         spec = field_spec(field, tol_overrides)
@@ -127,7 +146,8 @@ def compare(prev: Dict[str, object], cur: Dict[str, object],
             row["status"] = "n/a"
             rows.append(row)
             continue
-        if method_changed and field.startswith("weight_sync"):
+        if (method_changed and field.startswith("weight_sync")) or \
+                (ring_method_changed and field.startswith("ring_")):
             row["status"] = "skipped-method-change"
             rows.append(row)
             continue

@@ -30,6 +30,17 @@ Standalone probes (docs/benchmarks.md Tools):
                                       budget (default 64 MB); runs on
                                       CPU test meshes or real chips
                                       (docs/weight_sync.md §device)
+  ring-bench [sp,sp,...] [seq,seq,...]
+                                      sweep ring attention v2
+                                      (parallel/ring.py) over
+                                      (sp, seq_len): fwd+bwd step time
+                                      zigzag vs the naive v1 oracle plus
+                                      the structural causal-skip ratio
+                                      ((n+1)/2n at sp=n); runs on CPU
+                                      host meshes (JAX_PLATFORMS=cpu +
+                                      --xla_force_host_platform_device_
+                                      count=N) or real chips
+                                      (docs/parallelism.md §PP∘SP)
 
 Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
@@ -1172,6 +1183,61 @@ def reshard_bench(src_spec: str = "f2t2", dst_spec: str = "d4",
           f"(zero-copy leaves: {len(plan2.identical)})")
 
 
+def ring_bench(sp_list=None, seq_list=None, reps: int = 3) -> None:
+    """Sweep ring attention v2 (parallel/ring.py) over (sp, seq_len) on
+    whatever devices this process has (host meshes under JAX_PLATFORMS=cpu
+    + XLA_FLAGS=--xla_force_host_platform_device_count=N, real chips
+    otherwise): fwd+bwd step time for the zig-zag schedule vs the
+    contiguous v1 oracle, plus the structural causal-skip ratio from the
+    trace-time area counters ((n+1)/2n at sp=n)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.parallel import mesh as pm
+    from areal_tpu.parallel import ring as ring_mod
+
+    n_dev = len(jax.devices())
+    sp_list = sp_list or [s for s in (1, 2, 4, 8) if s <= n_dev]
+    seq_list = seq_list or [1024, 2048, 4096]
+    Hq, Hkv, Dh = 4, 2, 64
+    print(f"[ring-bench] {n_dev} {jax.devices()[0].platform} devices; "
+          f"B=1 Hq={Hq} Hkv={Hkv} Dh={Dh}; fwd+bwd attention step, "
+          f"zigzag (active) vs naive (v1 oracle)")
+    print(f"[ring-bench] {'sp':>3} {'seq_len':>8} {'zigzag_ms':>10} "
+          f"{'naive_ms':>9} {'speedup':>8} {'skip_ratio':>10}")
+    rng = np.random.RandomState(0)
+    for sp in sp_list:
+        mesh = pm.make_mesh(pm.ParallelSpec(sp=sp))
+        for T in seq_list:
+            if T % max(2 * sp, 1):
+                continue
+            q = jnp.asarray(rng.randn(1, T, Hq, Dh).astype(np.float32) * .1)
+            k = jnp.asarray(rng.randn(1, T, Hkv, Dh).astype(np.float32) * .1)
+            v = jnp.asarray(rng.randn(1, T, Hkv, Dh).astype(np.float32) * .1)
+            seg = jnp.ones((1, T), jnp.int32)
+            res = {}
+            for sched in ("zigzag", "naive"):
+                def loss(q, k, v, sched=sched):
+                    o = ring_mod.ring_attention(q, k, v, seg, mesh,
+                                                schedule=sched)
+                    return jnp.sum(o * o)
+
+                f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                ring_mod.reset_ring_counters()
+                jax.block_until_ready(f(q, k, v))  # compile; fill counters
+                ratio = ring_mod.ring_skip_ratio()
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    g = f(q, k, v)
+                jax.block_until_ready(g)
+                res[sched] = ((time.perf_counter() - t0) / reps * 1e3, ratio)
+            zz, nv = res["zigzag"], res["naive"]
+            print(f"[ring-bench] {sp:>3} {T:>8} {zz[0]:>10.2f} "
+                  f"{nv[0]:>9.2f} {nv[0] / max(zz[0], 1e-9):>7.2f}x "
+                  f"{zz[1]:>10.3f}")
+
+
 def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
                                    "flight-dump", "packfill", "blocksweep",
@@ -1179,7 +1245,7 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "fleet-status", "drain", "cordon",
                                    "uncordon", "reward-bench", "alerts",
                                    "silence", "goodput", "reshard-bench",
-                                   "spool-status"):
+                                   "ring-bench", "spool-status"):
         return False
     cmd = argv[0]
     try:
@@ -1245,6 +1311,13 @@ def _dispatch_fleet_commands(argv) -> bool:
                 int(argv[3]) if len(argv) > 3 else 64,
                 int(argv[4]) if len(argv) > 4 else 8,
                 int(argv[5]) if len(argv) > 5 else 1024,
+            )
+        elif cmd == "ring-bench":
+            ring_bench(
+                [int(x) for x in argv[1].split(",")] if len(argv) > 1
+                else None,
+                [int(x) for x in argv[2].split(",")] if len(argv) > 2
+                else None,
             )
         elif cmd == "profile-trigger":
             profile_trigger(argv[1], argv[2], argv[3],
