@@ -506,6 +506,55 @@ def validate_config(cfg) -> None:
                     "generation fleet — e.g. sp2 -> d2 "
                     "(docs/parallelism.md §PP∘SP)."
                 )
+            if spec is not None and spec.ep > 1:
+                raise ConfigError(
+                    f"allocation_mode {label} spec '{spec}' sets ep="
+                    f"{spec.ep}, but expert parallelism only applies to "
+                    "training: the decode hot loop runs the replicated "
+                    "einsum dispatch (models/moe.py never all-to-alls "
+                    "under a KV cache). Move the ep factor into dp or tp "
+                    "for the generation fleet — e.g. e2 -> d2 "
+                    "(docs/parallelism.md §Expert parallelism)."
+                )
+        # Expert-parallel train specs need a MoE model whose expert count
+        # divides over the axis; anything else silently replicates or
+        # crashes inside shard_map at step time, so fail at parse time.
+        moe_dict = getattr(getattr(cfg, "actor", None), "tiny", None)
+        moe_dict = moe_dict.get("moe") if isinstance(moe_dict, dict) else None
+        train_specs = [("global", alloc.global_spec)]
+        train_specs += [(f"MFC '{m}'", s) for m, s in
+                        sorted(alloc.per_mfc.items()) if m != "actor_gen"]
+        for label, spec in train_specs:
+            if spec is None or spec.ep <= 1:
+                continue
+            if not isinstance(moe_dict, dict):
+                raise ConfigError(
+                    f"allocation_mode {label} spec '{spec}' sets ep="
+                    f"{spec.ep} but the model is dense (actor.tiny.moe is "
+                    "unset): there are no experts to shard. Drop the ep "
+                    "factor or configure actor.tiny.moe "
+                    "(docs/parallelism.md §Expert parallelism)."
+                )
+            n_exp = int(moe_dict.get("num_experts", 8))
+            if n_exp % spec.ep != 0:
+                raise ConfigError(
+                    f"allocation_mode {label} spec '{spec}' sets ep="
+                    f"{spec.ep}, which does not divide "
+                    f"actor.tiny.moe.num_experts={n_exp}: every ep shard "
+                    "must own the same number of experts "
+                    "(docs/parallelism.md §Expert parallelism)."
+                )
+    moe_dict = getattr(getattr(cfg, "actor", None), "tiny", None)
+    moe_dict = moe_dict.get("moe") if isinstance(moe_dict, dict) else None
+    if isinstance(moe_dict, dict):
+        cf = float(moe_dict.get("capacity_factor", 2.0))
+        if cf <= 0:
+            raise ConfigError(
+                f"actor.tiny.moe.capacity_factor={cf} must be > 0: the "
+                "expert buffer is ceil(top_k * tokens * capacity_factor "
+                "/ num_experts) slots, and a non-positive factor drops "
+                "every routed token (models/moe.py capacity)."
+            )
     nr = getattr(getattr(cfg, "cluster", None), "name_resolve", None)
     if nr is not None and getattr(nr, "type", "nfs") == "etcd3":
         # Same contract as the mode=ray rejection above: the descoped

@@ -520,6 +520,8 @@ class JaxTrainEngine(TrainableEngine):
         pp_on, ring_on = ppl.pp_engagement(self.mesh, self.cfg, R, L)
         telemetry.set_gauge("train/pp_engaged", pp_on)
         telemetry.set_gauge("train/ring_engaged", ring_on)
+        telemetry.set_gauge("train/moe_ep_engaged",
+                            self._ep_engagement(R, L, pp_on))
         S = max(len(mb.seq_mask) for mb in mbs)
         S = mbu.packing.round_up(S, self.seqs_bucket)
         grids: Dict[str, jnp.ndarray] = {}
@@ -712,16 +714,62 @@ class JaxTrainEngine(TrainableEngine):
             })
         if bool(fetched["update_applied"]):
             self.opt_step_count += 1
-        out = {k: float(v) for k, v in fetched.items()}
-        for k in out:
-            if k.startswith("moe_"):
-                out[k] /= max(len(idxs), 1)
+        out = self._finish_stats(fetched, len(idxs))
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(ub.mbs[i].n_tokens for i in idxs))
         out["loss_weight"] = total_w
         telemetry.inc("train/tokens", out["total_tokens"])
         telemetry.inc("train/optimizer_steps",
                       1.0 if bool(fetched["update_applied"]) else 0.0)
+        return out
+
+    def _ep_engagement(self, batch: int, seq_len: int, pp_on: float) -> float:
+        """0/1 gauge: will the MoE all-to-all expert-parallel path engage
+        for this shape? Mirrors the forward gate (transformer._block):
+        never inside pipeline stages (already-manual regions — there GSPMD
+        alone handles the ep-sharded weights), otherwise moe.ep_eligible
+        on the engine mesh."""
+        from areal_tpu.models import moe as moe_mod
+
+        if pp_on:
+            return 0.0
+        return float(moe_mod.ep_eligible(
+            self.mesh, getattr(self.cfg, "moe", None), batch, seq_len
+        ))
+
+    # Per-expert routed-load shares cluster around 1/E — log-ish buckets.
+    _EXPERT_LOAD_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05,
+                            0.1, 0.2, 0.5, 1.0)
+
+    def _finish_stats(self, fetched: Dict[str, Any],
+                      n_mbs: int) -> Dict[str, float]:
+        """Host-side stat post-processing shared by train_batch and the
+        uniform path. Vector-valued stats — the [E] ``moe_expert_load``
+        histogram — are split off BEFORE scalar conversion (float() on a
+        vector raises) and published as a telemetry distribution; "moe_"
+        stats are per-mb means accumulated as sums, so divide by the mb
+        count; the routing-health scalars also land on the scrape as
+        ``train/moe_*`` gauges (docs/observability.md; the sentinel
+        ``expert_collapse`` rule baselines the load ratio)."""
+        n_mbs = max(n_mbs, 1)
+        vec = {k: v for k, v in fetched.items()
+               if getattr(v, "ndim", 0) > 0 and np.size(v) > 1}
+        out = {k: float(v) for k, v in fetched.items() if k not in vec}
+        for k in out:
+            if k.startswith("moe_"):
+                out[k] /= n_mbs
+        load = vec.get("moe_expert_load")
+        if load is not None:
+            for share in np.asarray(load, np.float64).reshape(-1) / n_mbs:
+                telemetry.observe("train/moe_expert_load_dist",
+                                  float(share),
+                                  buckets=self._EXPERT_LOAD_BUCKETS)
+        for stat, gauge in (
+            ("moe_dropped_frac", "train/moe_dropped_frac"),
+            ("moe_expert_load_ratio", "train/moe_expert_load_ratio"),
+        ):
+            if stat in out:
+                telemetry.set_gauge(gauge, out[stat])
         return out
 
     def _device_batch(self, mb: mbu.MicroBatch) -> Dict[str, jnp.ndarray]:
@@ -772,6 +820,8 @@ class JaxTrainEngine(TrainableEngine):
                                            mb_len)
         telemetry.set_gauge("train/pp_engaged", pp_on)
         telemetry.set_gauge("train/ring_engaged", ring_on)
+        telemetry.set_gauge("train/moe_ep_engaged",
+                            self._ep_engagement(mb_rows, mb_len, pp_on))
         weights = [float(loss_weight_fn(mb)) for mb in mbs]
         total_w = sum(weights)
         rule = None
@@ -841,11 +891,7 @@ class JaxTrainEngine(TrainableEngine):
             self.opt_step_count += 1
         # Engine bookkeeping keys are written AFTER the user stats and would
         # clobber same-named loss_fn stats — keep them namespaced.
-        out = {k: float(v) for k, v in fetched.items()}
-        # "moe_" stats are per-mb means accumulated as sums — report means.
-        for k in out:
-            if k.startswith("moe_"):
-                out[k] /= max(len(mbs), 1)
+        out = self._finish_stats(fetched, len(mbs))
         out["lr"] = applied_lr
         out["total_tokens"] = float(sum(mb.n_tokens for mb in mbs))
         out["loss_weight"] = total_w

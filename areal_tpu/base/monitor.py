@@ -38,16 +38,30 @@ def transformer_flops_per_token(
     avg_seqlen: float,
     backward: bool = True,
     remat: bool = False,
+    moe=None,
 ) -> float:
     """Analytic FLOPs per token (llama formula family, reference
     monitor.py:288-330): matmul terms 2·m·n·k plus the attention-score
     quadratic term; backward ≈ 2× forward, or 3× forward under activation
     rematerialization (the forward is recomputed in the backward pass —
-    reference checkpoint_activations_factor=4)."""
+    reference checkpoint_activations_factor=4).
+
+    ``moe`` (a models.config.MoEConfig or anything with its fields)
+    switches the MLP term to ACTIVATED compute: each token runs top_k
+    routed experts plus the router matmul plus the always-on shared
+    expert — not all num_experts — so MoE MFU is measured against the
+    FLOPs the token actually buys, matching activated_param_count
+    (models/transformer.py)."""
     d, f = hidden_dim, intermediate_dim
     attn_proj = 2 * d * (q_dim + 2 * kv_dim) + 2 * q_dim * d
     attn_score = 2 * 2 * q_dim * avg_seqlen  # QK^T and PV, causal avg ≈ L/2·2
-    mlp = 3 * 2 * d * f
+    if moe is not None:
+        fr = moe.routed_intermediate_dim or f
+        mlp = moe.top_k * 3 * 2 * d * fr + 2 * d * moe.num_experts
+        if moe.shared_intermediate_dim:
+            mlp += 3 * 2 * d * moe.shared_intermediate_dim
+    else:
+        mlp = 3 * 2 * d * f
     per_layer = attn_proj + attn_score + mlp
     head = 2 * d * vocab_size
     fwd = n_layers * per_layer + head
@@ -75,6 +89,7 @@ def model_flops_per_token(
         cfg.n_layers, cfg.hidden_dim, cfg.q_dim, cfg.kv_dim,
         cfg.intermediate_dim, 1 if cfg.is_critic else cfg.vocab_size,
         avg_seqlen, backward=backward, remat=remat,
+        moe=getattr(cfg, "moe", None),
     )
 
 

@@ -90,7 +90,14 @@ def tiny_config(
     is_critic: bool = False,
     **kw,
 ) -> TransformerConfig:
-    """Small fabricated config for tests (reference testing.py:37-43)."""
+    """Small fabricated config for tests (reference testing.py:37-43).
+
+    A ``moe`` kwarg may be a plain dict (the YAML/CLI ``actor.tiny.moe``
+    form) — it is coerced to :class:`MoEConfig` here so every downstream
+    consumer sees the dataclass.
+    """
+    if isinstance(kw.get("moe"), dict):
+        kw["moe"] = MoEConfig(**kw["moe"])
     return TransformerConfig(
         n_layers=n_layers,
         hidden_dim=hidden_dim,

@@ -1,34 +1,70 @@
-"""Mixture-of-Experts layer — TPU-first (GShard-style dense dispatch).
+"""Mixture-of-Experts layer — TPU-first, sort-based grouped expert compute.
 
 Parity target: ``realhf/impl/model/modules/moe/`` — ``TopKRouter``
 (router.py:24; aux-loss load balancing :78, z-loss :146, input jitter
 :170), token dispatcher (token_dispatcher.py: permute + capacity drop) and
 ``GroupedMLP`` (experts.py:99, grouped_gemm). TPU-first differences:
 
- - no permute/unpermute or grouped-GEMM library: tokens are dispatched to
-   fixed-capacity expert buffers with one-hot einsums (GShard/Switch
-   layout) so every op is a static-shape batched matmul on the MXU;
- - expert parallelism = sharding the expert axis of the stacked weights
-   over the "fsdp" mesh axis (parallel/sharding.py) — GSPMD inserts the
-   all-to-alls the reference's dispatcher would hand-code (the reference
-   itself ships with ep_size=1 only);
+ - the production dispatch is **grouped** (MegaBlocks/dropless-MoE style):
+   flatten (token, choice) entries, stable-argsort by expert id, and run
+   the expert MLPs as grouped GEMMs over contiguous per-expert segments
+   via ``jax.lax.ragged_dot`` (sorted-segment fallback on jax versions
+   without it). Expert FLOPs/HBM scale with the tokens actually routed —
+   no dense ``[E, C]`` capacity buffers on the compute path;
+ - the original GShard one-hot-einsum dispatch is kept VERBATIM as the
+   parity ORACLE behind ``AREAL_MOE_DISPATCH=einsum`` (same contract as
+   ``AREAL_RING_SCHEDULE`` / ``AREAL_PP_SCHEDULE``). Both paths share the
+   router/aux code and implement the identical Switch-style capacity/drop
+   policy (priority = token order then choice order), so outputs and
+   grads agree including dropped tokens and padding masks;
+ - expert parallelism is a REAL mesh axis ("ep", parallel/mesh.py):
+   expert weights shard over it (parallel/sharding.py) and
+   :func:`moe_mlp` given a mesh with ep > 1 runs an all-to-all path —
+   tokens dispatch into per-source capacity buffers, all-to-all to the
+   shard owning their expert, batched expert GEMMs, and all-to-all back
+   (GShard §3.2). Capacity/drop applies at the SHARD boundary (per-source
+   ``capacity(N/ep)``), so the a2a payload is static-shape; the reference
+   itself ships with ep_size=1 only;
  - sinkhorn routing is not implemented (the reference defaults to aux-loss
    balancing for its shipped configs).
 
 Weights per layer (stacked on the leading layer axis by the transformer):
 ``router [D, E]``, ``e_gate/e_up [E, D, F]``, ``e_down [E, F, D]``, and an
 optional always-on shared expert ``s_gate/s_up [D, Fs]``, ``s_down [Fs, D]``.
+
+Routing-health aux (exported as ``train/moe_*`` telemetry by
+backend/jax_train.py; docs/observability.md): ``dropped_frac``,
+``expert_load`` ([E] fraction of routed assignments per expert, pre-drop)
+and ``expert_load_ratio`` (max/mean of that — 1.0 is perfectly balanced,
+→ E is total collapse; the sentinel ``expert_collapse`` rule baselines it).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, Tuple
+import os
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
 
 from areal_tpu.models.config import MoEConfig
+
+DISPATCH_METHODS = ("grouped", "einsum")
+
+
+def resolve_dispatch(method: Optional[str] = None) -> str:
+    """The dispatch actually run: explicit arg > ``AREAL_MOE_DISPATCH`` >
+    "grouped". "einsum" is the GShard one-hot oracle kept for parity."""
+    if method is None:
+        method = os.environ.get("AREAL_MOE_DISPATCH", "").strip() or "grouped"
+    if method not in DISPATCH_METHODS:
+        raise ValueError(
+            f"unknown MoE dispatch {method!r} (one of {DISPATCH_METHODS})"
+        )
+    return method
 
 
 def capacity(n_tokens: int, moe: MoEConfig) -> int:
@@ -36,28 +72,36 @@ def capacity(n_tokens: int, moe: MoEConfig) -> int:
     return max(int(c), 1)
 
 
-def moe_mlp(
-    x: jnp.ndarray,  # [B, T, D]
-    lp: Dict[str, jnp.ndarray],  # this layer's params
-    moe: MoEConfig,
-    rng: jnp.ndarray = None,  # jitter noise (training only); None = off
-    mask: jnp.ndarray = None,  # [B, T] bool/int — True for real tokens
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Returns (output [B, T, D], aux dict with load_balance_loss / z_loss /
-    aux_total / dropped_frac).
-
-    ``mask`` excludes grid-padding tokens from routing entirely: they take
-    no expert-capacity slots and do not enter the balancing/z statistics
-    (the reference runs on unpadded packed tokens, so padding never exists
-    there; with [B, T] grids it must be masked out explicitly)."""
-    B, T, D = x.shape
-    E, k = moe.num_experts, moe.top_k
-    N = B * T
-    xf = x.reshape(N, D)
-    valid = (
-        jnp.ones((N,), jnp.float32) if mask is None
-        else mask.reshape(N).astype(jnp.float32)
+def ep_eligible(mesh: Optional[Mesh], moe: Optional[MoEConfig],
+                batch: int, seq_len: int = 1) -> bool:
+    """Whether the all-to-all expert-parallel path can run: a real "ep"
+    mesh axis, experts dividing over it, and batch/seq dims that divide
+    their mesh axes (the full-manual shard_map needs exact blocks — e.g.
+    generate()'s unbucketed batch dim does not divide, mirroring
+    ring_eligible)."""
+    if mesh is None or moe is None:
+        return False
+    ep = dict(mesh.shape).get("ep", 1)
+    if ep <= 1 or moe.num_experts % ep:
+        return False
+    return (
+        batch % (mesh.shape["dp"] * mesh.shape["fsdp"] * ep) == 0
+        and seq_len % mesh.shape["sp"] == 0
     )
+
+
+# ---------------- router + balancing stats (shared by all paths) ----------------
+
+def _routing(
+    xf: jnp.ndarray,  # [N, D]
+    lp: Dict[str, jnp.ndarray],
+    moe: MoEConfig,
+    rng: Optional[jnp.ndarray],
+    valid: jnp.ndarray,  # [N] float 0/1
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (top_p [N, k] post-norm gates, top_i [N, k], onehot
+    [N, k, E] with padding rows zeroed, aux dict sans dropped_frac)."""
+    E, k = moe.num_experts, moe.top_k
     n_valid = jnp.maximum(jnp.sum(valid), 1.0)
 
     router_in = xf
@@ -83,45 +127,293 @@ def moe_mlp(
     onehot = jax.nn.one_hot(top_i, E, dtype=jnp.float32)  # [N, k, E]
     onehot = onehot * valid[:, None, None]  # padding routes nowhere
     routed = jnp.sum(onehot, axis=1)  # [N, E] 0/1 counts
-    f = jnp.sum(routed, axis=0) / n_valid * E / k
-    P = jnp.sum(probs * valid[:, None], axis=0) / n_valid
-    load_balance = jnp.sum(f * P)
+    counts_e = jnp.sum(routed, axis=0)  # [E] routed assignments per expert
+    f = counts_e / n_valid * E / k
+    Pm = jnp.sum(probs * valid[:, None], axis=0) / n_valid
+    load_balance = jnp.sum(f * Pm)
     z = jnp.sum((jax.nn.logsumexp(logits, axis=-1) ** 2) * valid) / n_valid
     aux_total = moe.aux_loss_coeff * load_balance + moe.z_loss_coeff * z
 
-    # ---- capacity dispatch ----
-    C = capacity(N, moe)
-    # position of each (token, choice) within its expert buffer: priority is
-    # token order then choice order (same as the reference's dispatcher);
-    # padding tokens have zeroed onehot and consume no slots.
+    # Routing-health stats (pre-drop): per-expert share of assignments,
+    # and its max/mean ratio (1 = balanced, E = collapse onto one expert).
+    expert_load = counts_e / jnp.maximum(n_valid * k, 1.0)  # [E], sums to 1
+    load_ratio = jnp.max(expert_load) / jnp.maximum(
+        jnp.mean(expert_load), 1e-9
+    )
+    aux = {
+        "aux_total": aux_total,
+        "load_balance_loss": load_balance,
+        "z_loss": z,
+        "expert_load": expert_load,
+        "expert_load_ratio": load_ratio,
+    }
+    return top_p, top_i, onehot, aux
+
+
+def _capacity_keep(onehot: jnp.ndarray, C: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Switch-style slot assignment: position of each (token, choice)
+    within its expert's capacity buffer — priority is token order then
+    choice order (same as the reference's dispatcher); padding tokens have
+    zeroed onehot and consume no slots. Returns (pos [N, k], keep [N, k])."""
+    N, k, E = onehot.shape
     flat_oh = onehot.reshape(N * k, E)
     pos = (jnp.cumsum(flat_oh, axis=0) - flat_oh).reshape(N, k, E)
     pos = jnp.sum(pos * onehot, axis=-1)  # [N, k] slot per choice
     keep = (pos < C) & (jnp.sum(onehot, axis=-1) > 0)
+    return pos, keep
+
+
+def _expert_ffn(xe, gate_w, up_w, down_w):
+    """Batched silu-gated expert MLP over [E, rows, D] capacity buffers."""
+    h = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", xe, gate_w)
+    ) * jnp.einsum("ecd,edf->ecf", xe, up_w)
+    return jnp.einsum("ecf,efd->ecd", h, down_w)  # [E, rows, D]
+
+
+# ---------------- einsum dispatch (GShard oracle) ----------------
+
+def _dispatch_einsum(
+    xf: jnp.ndarray,  # [N, D]
+    top_p: jnp.ndarray,  # [N, k]
+    onehot: jnp.ndarray,  # [N, k, E]
+    lp: Dict[str, jnp.ndarray],
+    moe: MoEConfig,
+    n_valid: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The original one-hot capacity-buffer dispatch — every op a
+    static-shape batched matmul, FLOPs/HBM scale with E × capacity.
+    Kept as the parity oracle (``AREAL_MOE_DISPATCH=einsum``)."""
+    N, D = xf.shape
+    k = moe.top_k
+    C = capacity(N, moe)
+    pos, keep = _capacity_keep(onehot, C)
     gate = top_p * keep  # dropped tokens contribute nothing
     dropped_frac = 1.0 - jnp.sum(keep) / jnp.maximum(n_valid * k, 1.0)
 
     # combine [N, E, C] — sparse; also serves (as booleans) for dispatch.
     slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
     combine = jnp.einsum("nke,nkc,nk->nec", onehot, slot_oh, gate)
-    dispatch = (combine > 0).astype(x.dtype)
+    dispatch = (combine > 0).astype(xf.dtype)
 
     xe = jnp.einsum("nec,nd->ecd", dispatch, xf)  # [E, C, D]
-    h = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", xe, lp["e_gate"])
-    ) * jnp.einsum("ecd,edf->ecf", xe, lp["e_up"])
-    ye = jnp.einsum("ecf,efd->ecd", h, lp["e_down"])  # [E, C, D]
+    ye = _expert_ffn(xe, lp["e_gate"], lp["e_up"], lp["e_down"])
     y = jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
+    return y, dropped_frac
+
+
+# ---------------- grouped dispatch (sorted segments, the default) ----------------
+
+def _grouped_matmul(xs: jnp.ndarray,  # [M, K] rows sorted by group
+                    w: jnp.ndarray,  # [G, K, F]
+                    group_sizes: jnp.ndarray,  # [G] int32
+                    ) -> jnp.ndarray:
+    """Grouped GEMM over contiguous row segments: row m multiplies
+    ``w[g]`` where m falls in group g's segment. Rows beyond
+    ``sum(group_sizes)`` yield zeros (ragged_dot guarantees this; the
+    fallback masks them out) — sentinel-sorted padding entries land there.
+    """
+    if hasattr(jax.lax, "ragged_dot"):
+        return jax.lax.ragged_dot(xs, w, group_sizes)
+    # Sorted-segment fallback (pre-ragged_dot jax): static unroll over
+    # groups with masked dense matmuls — correct, not fast.
+    starts = jnp.cumsum(group_sizes) - group_sizes
+    ends = starts + group_sizes
+    idx = jnp.arange(xs.shape[0])
+    out = jnp.zeros((xs.shape[0], w.shape[-1]), dtype=xs.dtype)
+    for g in range(w.shape[0]):
+        m = ((idx >= starts[g]) & (idx < ends[g])).astype(xs.dtype)
+        out = out + (xs * m[:, None]) @ w[g]
+    return out
+
+
+def _dispatch_grouped(
+    xf: jnp.ndarray,  # [N, D]
+    top_p: jnp.ndarray,  # [N, k]
+    top_i: jnp.ndarray,  # [N, k]
+    valid: jnp.ndarray,  # [N]
+    lp: Dict[str, jnp.ndarray],
+    moe: MoEConfig,
+    n_valid: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sort-based grouped expert compute: one stable argsort of the
+    ``M = N·top_k`` (token, choice) entries by expert id makes each
+    expert's rows contiguous, so the expert MLP is three grouped GEMMs
+    over ``[M, D]`` instead of one-hot einsums over ``[E, C, D]`` buffers.
+
+    Drop parity with the oracle is structural: a stable sort preserves
+    flat (token-major, then choice) order within each expert, so an
+    entry's position inside its segment IS the oracle's capacity-slot
+    ``pos`` — ``pos >= C`` entries keep their gate zeroed (their GEMM rows
+    are computed but contribute nothing, exactly like the oracle's
+    unslotted tokens). Padding entries get sentinel id E, sort to the
+    tail beyond ``sum(group_sizes)``, and come back as zeros."""
+    N, D = xf.shape
+    E, k = moe.num_experts, moe.top_k
+    M = N * k
+    C = capacity(N, moe)
+
+    valid_b = valid.reshape(N, 1) > 0
+    eid = jnp.where(valid_b, top_i, E).reshape(M)  # sentinel E = padding
+    order = jnp.argsort(eid)  # jnp argsort is stable
+    sorted_eid = jnp.take(eid, order)
+    counts = jnp.bincount(eid, length=E + 1)  # [E+1], sentinel bin last
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(M) - jnp.take(starts, sorted_eid)  # slot within segment
+    keep = (pos < C) & (sorted_eid < E)
+    dropped_frac = 1.0 - jnp.sum(keep) / jnp.maximum(n_valid * k, 1.0)
+    gate = jnp.take(top_p.reshape(M), order) * keep
+
+    xs = jnp.take(xf, order // k, axis=0)  # [M, D] sorted expert inputs
+    group_sizes = counts[:E].astype(jnp.int32)
+    h = jax.nn.silu(
+        _grouped_matmul(xs, lp["e_gate"], group_sizes)
+    ) * _grouped_matmul(xs, lp["e_up"], group_sizes)
+    ys = _grouped_matmul(h, lp["e_down"], group_sizes)  # [M, D]
+    ys = ys * gate.astype(ys.dtype)[:, None]
+    inv = jnp.argsort(order)  # inverse permutation
+    y = jnp.sum(jnp.take(ys, inv, axis=0).reshape(N, k, D), axis=1)
+    return y, dropped_frac
+
+
+# ---------------- expert-parallel dispatch (all-to-all over "ep") ----------------
+
+def _dispatch_ep(
+    x: jnp.ndarray,  # [B, T, D] global
+    top_p: jnp.ndarray,  # [N, k]
+    top_i: jnp.ndarray,  # [N, k]
+    valid: jnp.ndarray,  # [N]
+    lp: Dict[str, jnp.ndarray],
+    moe: MoEConfig,
+    mesh: Mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GShard §3.2 expert parallelism over the mesh's "ep" axis: each ep
+    shard dispatches its LOCAL tokens into per-destination capacity
+    buffers (``capacity(N/ep)`` per source — the drop/pad happens at the
+    shard boundary, so the exchange is static-shape), all-to-alls rows to
+    the shard owning the expert, runs the batched expert GEMMs on its
+    ``E/ep`` local experts × ``ep·C`` rows, and all-to-alls back for the
+    local gate-weighted combine.
+
+    Full-manual shard_map (the ring_attention pattern — 0.4.x's partial-
+    manual partitioner miscompiles auto axes sharing a dim with manual
+    ones): tokens split over DATA_AXES × sp, expert weights over ep with
+    their ffn dim over tp (Megatron column→row: the ``e_down`` partial
+    sums psum over "tp"); the ZeRO-3 fsdp shard of the weights
+    all-gathers at the region boundary, exactly what GSPMD does for the
+    dense paths. Numerics match the replicated paths exactly in the
+    no-drop regime; under drops the priority is per-source-shard rather
+    than global (tested/documented — docs/parallelism.md §Expert
+    parallelism)."""
+    from areal_tpu.parallel.compat import shard_map
+    from areal_tpu.parallel.mesh import DATA_AXES
+
+    B, T, D = x.shape
+    E, k = moe.num_experts, moe.top_k
+    tok_axes = DATA_AXES + ("sp",)
+
+    def body(xl, gl, il, vl, gate_w, up_w, down_w):
+        # Local shapes: xl [B/(dp·fsdp·ep), T/sp, D], gl/il [..., Tl, k],
+        # vl [..., Tl]; weights [E/ep, D, F/tp] / [E/ep, F/tp, D].
+        Bl, Tl = xl.shape[0], xl.shape[1]
+        Nl = Bl * Tl
+        xf = xl.reshape(Nl, D)
+        vf = vl.reshape(Nl).astype(jnp.float32)
+        onehot = jax.nn.one_hot(il.reshape(Nl, k), E, dtype=jnp.float32)
+        onehot = onehot * vf[:, None, None]
+        C = capacity(Nl, moe)  # per-SOURCE-shard capacity
+        pos, keep = _capacity_keep(onehot, C)
+        gate = gl.reshape(Nl, k) * keep
+        slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        combine = jnp.einsum("nke,nkc,nk->nec", onehot, slot_oh, gate)
+        dispatch = (combine > 0).astype(xl.dtype)
+
+        xe = jnp.einsum("nec,nd->ecd", dispatch, xf)  # [E, C, D]
+        # Ship each destination its experts' rows: [E, C, D] → split the
+        # expert axis into ep blocks, concat received by source along the
+        # row axis → [E/ep, ep·C, D] (rows grouped by source shard).
+        xin = jax.lax.all_to_all(xe, "ep", split_axis=0, concat_axis=1,
+                                 tiled=True)
+        ye = _expert_ffn(xin, gate_w, up_w, down_w)  # [E/ep, ep·C, D]
+        ye = jax.lax.psum(ye, "tp")  # row-parallel e_down partial sums
+        # Inverse exchange: row-block s back to source s, concat received
+        # by owner along the expert axis → [E, C, D] in global expert order.
+        ye = jax.lax.all_to_all(ye, "ep", split_axis=1, concat_axis=0,
+                                tiled=True)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
+
+        kept = jax.lax.psum(jnp.sum(keep.astype(jnp.float32)), tok_axes)
+        nv = jax.lax.psum(jnp.sum(vf), tok_axes)
+        dropped = 1.0 - kept / jnp.maximum(nv * k, 1.0)
+        return y.reshape(Bl, Tl, D), dropped
+
+    tok_spec = P(DATA_AXES, "sp")
+    y, dropped_frac = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXES, "sp", None), tok_spec, tok_spec, tok_spec,
+                  P("ep", None, "tp"), P("ep", None, "tp"),
+                  P("ep", "tp", None)),
+        out_specs=(P(DATA_AXES, "sp", None), P()),
+    )(
+        x,
+        top_p.reshape(B, T, k),
+        top_i.reshape(B, T, k),
+        valid.reshape(B, T),
+        lp["e_gate"], lp["e_up"], lp["e_down"],
+    )
+    return y.reshape(B * T, D), dropped_frac
+
+
+# ---------------- the layer ----------------
+
+def moe_mlp(
+    x: jnp.ndarray,  # [B, T, D]
+    lp: Dict[str, jnp.ndarray],  # this layer's params
+    moe: MoEConfig,
+    rng: jnp.ndarray = None,  # jitter noise (training only); None = off
+    mask: jnp.ndarray = None,  # [B, T] bool/int — True for real tokens
+    dispatch: Optional[str] = None,  # None → AREAL_MOE_DISPATCH → "grouped"
+    mesh: Optional[Mesh] = None,  # a mesh with ep > 1 → all-to-all EP path
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (output [B, T, D], aux dict with load_balance_loss / z_loss /
+    aux_total / dropped_frac / expert_load / expert_load_ratio).
+
+    ``mask`` excludes grid-padding tokens from routing entirely: they take
+    no expert-capacity slots and do not enter the balancing/z statistics
+    (the reference runs on unpadded packed tokens, so padding never exists
+    there; with [B, T] grids it must be masked out explicitly).
+
+    ``mesh``: pass the active mesh to take the expert-parallel all-to-all
+    path; callers must gate on :func:`ep_eligible` (and must NOT pass a
+    mesh from inside an already-manual shard_map region — the pipeline
+    stages fall back to the single-shard paths with GSPMD handling the
+    ep-sharded weights)."""
+    B, T, D = x.shape
+    N = B * T
+    xf = x.reshape(N, D)
+    valid = (
+        jnp.ones((N,), jnp.float32) if mask is None
+        else mask.reshape(N).astype(jnp.float32)
+    )
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+
+    top_p, top_i, onehot, aux = _routing(xf, lp, moe, rng, valid)
+
+    if mesh is not None and ep_eligible(mesh, moe, B, T):
+        y, dropped_frac = _dispatch_ep(x, top_p, top_i, valid, lp, moe, mesh)
+    elif resolve_dispatch(dispatch) == "einsum":
+        y, dropped_frac = _dispatch_einsum(xf, top_p, onehot, lp, moe, n_valid)
+    else:
+        y, dropped_frac = _dispatch_grouped(
+            xf, top_p, top_i, valid, lp, moe, n_valid
+        )
 
     if "s_gate" in lp:  # always-on shared expert (qwen-moe)
         y = y + (jax.nn.silu(xf @ lp["s_gate"]) * (xf @ lp["s_up"])) @ lp["s_down"]
 
-    aux = {
-        "aux_total": aux_total,
-        "load_balance_loss": load_balance,
-        "z_loss": z,
-        "dropped_frac": dropped_frac,
-    }
+    aux = dict(aux)
+    aux["dropped_frac"] = dropped_frac
     return y.reshape(B, T, D).astype(x.dtype), aux
 
 
@@ -131,20 +423,25 @@ def init_moe_params(cfg, key: jnp.ndarray, dtype) -> Dict[str, jnp.ndarray]:
     n, d = cfg.n_layers, cfg.hidden_dim
     f = moe.routed_intermediate_dim or cfg.intermediate_dim
     E = moe.num_experts
-    ks = jax.random.split(key, 8)
+    # One key per weight actually initialized — adding a weight grows the
+    # split instead of silently reusing a neighbour's key.
+    names = ["router", "e_gate", "e_up", "e_down"]
+    if moe.shared_intermediate_dim:
+        names += ["s_gate", "s_up", "s_down"]
+    ks = dict(zip(names, jax.random.split(key, len(names))))
 
     def nrm(k, shape, scale=0.02):
         return (jax.random.normal(k, shape) * scale).astype(dtype)
 
     out = {
-        "router": nrm(ks[0], (n, d, E)),
-        "e_gate": nrm(ks[1], (n, E, d, f)),
-        "e_up": nrm(ks[2], (n, E, d, f)),
-        "e_down": nrm(ks[3], (n, E, f, d)),
+        "router": nrm(ks["router"], (n, d, E)),
+        "e_gate": nrm(ks["e_gate"], (n, E, d, f)),
+        "e_up": nrm(ks["e_up"], (n, E, d, f)),
+        "e_down": nrm(ks["e_down"], (n, E, f, d)),
     }
     if moe.shared_intermediate_dim:
         fs = moe.shared_intermediate_dim
-        out["s_gate"] = nrm(ks[4], (n, d, fs))
-        out["s_up"] = nrm(ks[5], (n, d, fs))
-        out["s_down"] = nrm(ks[6], (n, fs, d))
+        out["s_gate"] = nrm(ks["s_gate"], (n, d, fs))
+        out["s_up"] = nrm(ks["s_up"], (n, d, fs))
+        out["s_down"] = nrm(ks["s_down"], (n, fs, d))
     return out

@@ -172,6 +172,7 @@ def _block(
     allow_ring: bool = True,
     ring_ctx=None,  # ring.RingCtx — already inside a manual sp region (PP∘SP)
     rng: Optional[jnp.ndarray] = None,  # per-layer key for MoE router jitter
+    allow_ep: bool = True,  # False inside manual regions (pipeline stages)
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray], Optional[Dict[str, jnp.ndarray]]]:
     B, T, D = h.shape
     dh = cfg.head_dim
@@ -259,9 +260,22 @@ def _block(
     if cfg.moe is not None:
         from areal_tpu.models import moe as moemod
 
+        # Expert-parallel all-to-all path: only from GSPMD-auto regions
+        # (a pipeline stage is already manual — nested shard_map is
+        # rejected there; GSPMD still handles its ep-sharded weights) and
+        # only for shard_map-divisible shapes; decode keeps the tolerant
+        # single-shard paths (generation never expert-parallels,
+        # api/cli_args.validate_config rejects it).
+        ep_mesh = current_mesh() if (
+            allow_ep and ring_ctx is None and cache_kv is None
+        ) else None
+        if ep_mesh is not None and not moemod.ep_eligible(
+                ep_mesh, cfg.moe, B, T):
+            ep_mesh = None
         mlp, aux = moemod.moe_mlp(
             x, lp, cfg.moe, rng=rng,
             mask=(segment_ids > 0) if segment_ids is not None else None,
+            mesh=ep_mesh,
         )
     elif cfg.mlp_type == "plain":
         mlp = act(x @ lp["w_up"] + lp["b_up"]) @ lp["w_down"] + lp["b_down"]
@@ -285,6 +299,8 @@ def apply_layer_stack(
     allow_ring: bool = True,
     ring_ctx=None,  # ring.RingCtx when inside a manual sp region (PP∘SP)
     rng: Optional[jnp.ndarray] = None,
+    allow_ep: bool = True,  # False inside manual regions (pipeline stages)
+    unroll: bool = False,  # python loop over layers instead of lax.scan
 ):
     """Run a stacked layer dict over ``h`` via lax.scan (packed mode, no KV
     out). Returns (h, aux) where aux stacks per-layer MoE scalars ({} for
@@ -298,7 +314,43 @@ def apply_layer_stack(
 
     ``rng``: base key for MoE router input jitter — split per layer and
     scanned alongside the params so each layer perturbs independently.
-    ``rng=None`` keeps the original scan body (bit-identical off path)."""
+    ``rng=None`` keeps the original scan body (bit-identical off path).
+
+    ``unroll``: replace the layer scan with a python loop. The 1F1B
+    pipeline stages set this for grouped-dispatch MoE: on jax 0.4.x the
+    transpose of this scan, nested inside the 1F1B backward's step scan
+    in a shard_map manual region, silently produces wrong cotangents for
+    the sort/gather ops of the grouped path (einsum dispatch and the
+    GSPMD non-pipelined path are unaffected; parallel/pipeline.py
+    _make_stage_fn has the full story). Stages hold n_layers/pp layers,
+    so the jaxpr growth is bounded and small."""
+
+    if unroll:
+        n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+        layer_keys = (jax.random.split(rng, n_layers)
+                      if rng is not None else None)
+
+        def body_i(h, lp, key):
+            h2, _, aux = _block(
+                cfg, h, lp, cos, sin, segment_ids, positions,
+                None, None, None, attn_impl, allow_ring=allow_ring,
+                ring_ctx=ring_ctx, rng=key, allow_ep=allow_ep,
+            )
+            return h2, aux
+
+        body_i = _maybe_checkpoint(body_i, remat)
+        auxes = []
+        for i in range(n_layers):
+            lp_i = jax.tree_util.tree_map(lambda a: a[i], layer_params)
+            h, aux = body_i(
+                h, lp_i, layer_keys[i] if layer_keys is not None else None
+            )
+            auxes.append(aux)
+        if auxes and auxes[0] is not None:
+            aux = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *auxes)
+        else:
+            aux = {}
+        return h, aux
 
     if rng is not None:
         n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
@@ -309,7 +361,7 @@ def apply_layer_stack(
             h2, _, aux = _block(
                 cfg, h, lp, cos, sin, segment_ids, positions,
                 None, None, None, attn_impl, allow_ring=allow_ring,
-                ring_ctx=ring_ctx, rng=key,
+                ring_ctx=ring_ctx, rng=key, allow_ep=allow_ep,
             )
             return h2, aux
 
@@ -321,7 +373,7 @@ def apply_layer_stack(
         h2, _, aux = _block(
             cfg, h, lp, cos, sin, segment_ids, positions,
             None, None, None, attn_impl, allow_ring=allow_ring,
-            ring_ctx=ring_ctx,
+            ring_ctx=ring_ctx, allow_ep=allow_ep,
         )
         return h2, aux
 
@@ -430,13 +482,16 @@ def forward(
                 cfg, h, layer_params, cos, sin, segment_ids, positions,
                 attn_impl=attn_impl, remat=remat, rng=rng,
             )
-    # aux ys are stacked per-layer [n_layers] (already reduced in the
-    # pipeline path). The optimized total SUMS over layers (the reference's
-    # aux tracker accumulates every MoE layer's loss); the diagnostic stats
-    # are reported as layer means.
+    # aux ys are stacked per-layer on a leading [n_layers] axis (already
+    # reduced in the pipeline path). The optimized total SUMS over layers
+    # (the reference's aux tracker accumulates every MoE layer's loss);
+    # the diagnostic stats are reported as layer means — vector stats
+    # (the [E] expert_load histogram) mean over the layer axis only.
     aux = (
         {
-            k: (jnp.sum(v) if k == "aux_total" else jnp.mean(v))
+            k: (jnp.sum(v) if k == "aux_total"
+                else jnp.mean(v, axis=0) if v.ndim > 1
+                else jnp.mean(v))
             for k, v in aux.items()
         }
         if aux is not None
@@ -499,3 +554,17 @@ def param_count(cfg: TransformerConfig) -> int:
         else 0
     )
     return v * d + n * per_layer + d + head + pos + (d if cfg.is_critic else 0)
+
+
+def activated_param_count(cfg: TransformerConfig) -> int:
+    """Parameters a token actually touches in one forward: for MoE, only
+    ``top_k`` of the ``num_experts`` routed FFNs (plus router and shared
+    expert) — the honest N for 6NT-style FLOPs/MFU accounting
+    (base/monitor.py); equals :func:`param_count` for dense models."""
+    if cfg.moe is None:
+        return param_count(cfg)
+    n, d, f = cfg.n_layers, cfg.hidden_dim, cfg.intermediate_dim
+    fr = cfg.moe.routed_intermediate_dim or f
+    total_mlp = cfg.moe.num_experts * 3 * d * fr
+    active_mlp = cfg.moe.top_k * 3 * d * fr
+    return param_count(cfg) - n * (total_mlp - active_mlp)

@@ -8,10 +8,12 @@ them; GSPMD inserts the collectives.
 
 Axis convention (order fixed so ICI-neighbour axes get the innermost dims):
 
-    ("dp", "fsdp", "pp", "sp", "tp")
+    ("dp", "fsdp", "ep", "pp", "sp", "tp")
 
  - ``dp``    pure data parallel (params replicated)
  - ``fsdp``  data parallel with params/opt-state sharded (ZeRO-3 style)
+ - ``ep``    expert parallel: a slice of the data dimension whose shards
+             own disjoint experts (models/moe.py all-to-alls tokens over it)
  - ``pp``    pipeline stages over the stacked-layer axis
  - ``sp``    sequence/context parallel (ring attention over this axis)
  - ``tp``    tensor parallel (heads / ffn sharded)
@@ -35,7 +37,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp")
+AXIS_ORDER = ("dp", "fsdp", "ep", "pp", "sp", "tp")
 # Short letter used in allocation strings per axis.
 _AXIS_LETTER = {"d": "dp", "f": "fsdp", "p": "pp", "s": "sp", "t": "tp", "e": "ep"}
 
@@ -44,9 +46,11 @@ _AXIS_LETTER = {"d": "dp", "f": "fsdp", "p": "pp", "s": "sp", "t": "tp", "e": "e
 class ParallelSpec:
     """Degrees along each mesh axis for one model role.
 
-    ``ep`` (expert parallel) is not a separate mesh axis: experts shard over
-    the fsdp×sp submesh (see sharding.py); the field records intent and is
-    validated against num_experts at model build time.
+    ``ep`` (expert parallel) is a REAL mesh axis: the batch dim shards over
+    it like dp/fsdp (DATA_AXES), expert weights shard their expert axis
+    over it (sharding.py), and models/moe.py all-to-alls tokens to the
+    shard owning their expert. Validated against num_experts at parse time
+    (api/cli_args.validate_config).
     """
 
     dp: int = 1
@@ -58,15 +62,15 @@ class ParallelSpec:
 
     @property
     def world_size(self) -> int:
-        return self.dp * self.fsdp * self.pp * self.sp * self.tp
+        return self.dp * self.fsdp * self.ep * self.pp * self.sp * self.tp
 
     @property
     def data_degree(self) -> int:
-        """Number of distinct data shards (dp × fsdp)."""
-        return self.dp * self.fsdp
+        """Number of distinct data shards (dp × fsdp × ep)."""
+        return self.dp * self.fsdp * self.ep
 
     def mesh_shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.pp, self.sp, self.tp)
+        return (self.dp, self.fsdp, self.ep, self.pp, self.sp, self.tp)
 
     @classmethod
     def parse(cls, s: str) -> "ParallelSpec":
@@ -120,9 +124,11 @@ def make_mesh(
     return Mesh(arr, AXIS_ORDER)
 
 
-# Composite axis names used in PartitionSpecs (sharding.py):
-DATA_AXES = ("dp", "fsdp")  # batch dim shards over both DP flavours
-EXPERT_AXES = ("fsdp", "sp")  # experts shard over fsdp×sp when ep > 1
+# Composite axis names used in PartitionSpecs (sharding.py): the batch dim
+# shards over every DP flavour — ep included, since expert parallelism is
+# a slice of the data dimension (tokens arrive ep-partitioned and the MoE
+# all-to-all moves them to their expert's shard).
+DATA_AXES = ("dp", "fsdp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)

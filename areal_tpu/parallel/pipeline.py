@@ -323,15 +323,18 @@ def _gpipe_apply_layers(
                 cfg, x, local_layers, take(cos_mbs), take(sin_mbs),
                 take(seg_mbs), take(pos_mbs), attn_impl=attn_impl,
                 remat=remat, allow_ring=True, ring_ctx=ring_ctx,
+                allow_ep=False,  # no nested shard_map inside the pp stages
             )
             # Bubble steps run garbage (their ys are never sliced out);
             # MoE aux must not count them.
             valid = ((s - stage >= 0) & (s - stage < n_micro)).astype(
                 jnp.float32
             )
+            # Index by aux_acc's (scalar) keys: aux may carry extra
+            # vector-valued stats the pipeline cannot accumulate.
             aux_acc = {
-                k: aux_acc[k] + valid * jnp.sum(v.astype(jnp.float32))
-                for k, v in aux.items()
+                k: aux_acc[k] + valid * jnp.sum(aux[k].astype(jnp.float32))
+                for k in aux_acc
             } if aux else aux_acc
             state = jax.lax.ppermute(y, "pp", fwd_perm)
             return (state, aux_acc), y
@@ -382,7 +385,13 @@ def _gpipe_apply_layers(
 
 
 def _aux_keys(cfg) -> Tuple[str, ...]:
-    return (("aux_total", "load_balance_loss", "z_loss", "dropped_frac")
+    """The SCALAR MoE aux keys the pipeline carries (accumulated across
+    micro-batches and psummed across stages). Vector-valued aux — the
+    per-expert ``expert_load`` histogram — is deliberately absent: the
+    pipeline's aux plumbing (scan carries, 1F1B cotangents) is
+    scalar-only, and the engine recomputes nothing it can't carry."""
+    return (("aux_total", "load_balance_loss", "z_loss", "dropped_frac",
+             "expert_load_ratio")
             if cfg.moe is not None else ())
 
 
@@ -396,6 +405,20 @@ def _make_stage_fn(cfg, attn_impl, remat):
                  ring_ctx=None):
         from areal_tpu.models import transformer as tfm
 
+        # Grouped-dispatch MoE stages unroll the per-stage layer loop:
+        # on jax 0.4.x CPU the layer scan's transpose, nested inside the
+        # 1F1B backward's step scan within the custom-vjp program,
+        # silently mis-computes the cotangents of the grouped path's
+        # sort/gather ops (~1e-2 off; the einsum oracle through the
+        # identical nesting is exact, as is this path with remat=True or
+        # with either scan replaced by a loop). A stage holds only
+        # n_layers/pp layers, so the unroll is cheap.
+        unroll = False
+        if cfg.moe is not None:
+            from areal_tpu.models import moe as moemod
+
+            unroll = moemod.resolve_dispatch() == "grouped"
+
         # Stage bodies trace inside a shard_map manual over {"pp"} or
         # {"pp","sp"}, but the trace POINT varies: the 1F1B custom-vjp
         # backward traces after pipeline_apply_layers' stripped-rules
@@ -407,9 +430,13 @@ def _make_stage_fn(cfg, attn_impl, remat):
                 cfg, x, local_layers, cos_j, sin_j, seg_j, pos_j,
                 attn_impl=attn_impl, remat=remat, allow_ring=True,
                 ring_ctx=ring_ctx,
+                allow_ep=False,  # no nested shard_map inside the pp stages
+                unroll=unroll,
             )
-        aux_sums = {k: jnp.sum(aux[k].astype(jnp.float32)) for k in aux} \
-            if aux else {}
+        # Only the scalar keys: the 1F1B backward builds cotangents from
+        # _aux_keys, and vector stats (expert_load) don't pipeline.
+        aux_sums = {k: jnp.sum(aux[k].astype(jnp.float32))
+                    for k in _aux_keys(cfg)} if aux else {}
         return y, aux_sums
 
     return stage_fn

@@ -355,7 +355,8 @@ def ring_eligible(mesh: Optional[Mesh], cfg, batch: int, seq_len: int,
         return False
     return (
         cfg.sliding_window is None
-        and batch % (mesh.shape["dp"] * mesh.shape["fsdp"]) == 0
+        and batch % (mesh.shape["dp"] * mesh.shape["fsdp"]
+                     * dict(mesh.shape).get("ep", 1)) == 0
         and seq_len % mesh.shape[axis_name] == 0
         and cfg.n_q_heads % mesh.shape["tp"] == 0
         and cfg.n_kv_heads % mesh.shape["tp"] == 0

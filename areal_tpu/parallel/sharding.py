@@ -9,10 +9,11 @@ activation ``with_sharding_constraint`` points. XLA/GSPMD inserts the
 all-reduces/all-gathers/reduce-scatters that Megatron hand-writes.
 
 Conventions (axes from mesh.AXIS_ORDER):
- - batch dim of activations: ("dp", "fsdp")
+ - batch dim of activations: ("dp", "fsdp", "ep")
  - sequence dim: "sp" (ring attention over this axis, parallel/ring.py)
  - heads / ffn dim of weights: "tp"; hidden dim of weights: "fsdp" (ZeRO-3)
- - stacked-layer axis: "pp"
+ - stacked-layer axis: "pp"; expert axis of MoE weights: "ep" (the MoE
+   layer all-to-alls tokens to their expert's shard, models/moe.py)
 """
 
 from __future__ import annotations
@@ -70,12 +71,14 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
         for k in ("w_gate",):
             layers.pop(k, None)
     if cfg.moe is not None:
-        # Experts stack on a leading axis [n, E, ...]; shard E over the fsdp
-        # axis (expert parallelism) and keep the ffn dim on tp.
+        # Experts stack on a leading axis [n, E, ...]; shard E over the
+        # REAL "ep" axis (expert parallelism — each ep shard owns E/ep
+        # experts, moe.py all-to-alls tokens to them), the ffn dim on tp,
+        # and ZeRO-3 the remaining matrix dim over fsdp.
         layers["router"] = P("pp", None, None)
-        layers["e_gate"] = P("pp", "fsdp", None, "tp")
-        layers["e_up"] = P("pp", "fsdp", None, "tp")
-        layers["e_down"] = P("pp", "fsdp", "tp", None)
+        layers["e_gate"] = P("pp", "ep", "fsdp", "tp")
+        layers["e_up"] = P("pp", "ep", "fsdp", "tp")
+        layers["e_down"] = P("pp", "ep", "tp", "fsdp")
         if cfg.moe.shared_intermediate_dim:
             layers["s_gate"] = P("pp", None, "tp")
             layers["s_up"] = P("pp", None, "tp")

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import time
+from types import SimpleNamespace
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.api.data import SequenceSample
@@ -301,6 +302,8 @@ class MasterWorker:
         n_tokens = float(sum(lens))
         avg = n_tokens / max(len(lens), 1)
 
+        moe_info = info.get("moe")
+
         class _C:  # adapter: monitor formulas take config-like fields
             n_layers = info["n_layers"]
             hidden_dim = info["hidden_dim"]
@@ -309,6 +312,12 @@ class MasterWorker:
             intermediate_dim = info["intermediate_dim"]
             vocab_size = info["vocab_size"]
             is_critic = info["is_critic"]
+            # Activated-compute geometry: monitor switches the MLP term
+            # to top_k routed + shared expert when this is set.
+            moe = (
+                None if moe_info is None
+                else SimpleNamespace(**moe_info)
+            )
 
         if node.interface_type == MFCInterfaceType.TRAIN_STEP:
             self._flops.add_train(

@@ -112,6 +112,13 @@ METRIC_CATALOG = frozenset({
     # schedule and ring attention actually engaged, plus the per-reason
     # GSPMD-fallback counter.
     "train/pp_engaged", "train/ring_engaged", "parallel/pp_fallback",
+    "train/moe_ep_engaged",
+    # MoE routing health (backend/jax_train.py publishes per train step):
+    # fraction of routed assignments dropped at the capacity boundary, the
+    # per-expert load share histogram, and its max/mean ratio (1 = balanced,
+    # num_experts = full collapse onto one expert).
+    "train/moe_dropped_frac", "train/moe_expert_load_dist",
+    "train/moe_expert_load_ratio",
     # goodput ledger + live MFU (system/goodput.py): per-worker
     # time-in-state counters, the trainer's achieved-FLOP/s gauges, the
     # generation servers' analytic decode/prefill FLOP/s, and the
@@ -256,6 +263,15 @@ DEFAULT_RULES: Tuple[Dict[str, Any], ...] = (
      "cooldown": 900, "severity": "warn",
      "description": "step wall time far off its rolling baseline "
                     "(throughput regression)"},
+    # Only has data on MoE runs: dense models never export the series,
+    # so the rule stays silent (baseline rules need samples to fire).
+    {"id": "expert_collapse", "metric": "train/moe_expert_load_ratio",
+     "kind": "baseline", "value": 8.0, "for": 30, "window": 1200,
+     "cooldown": 900, "severity": "warn",
+     "description": "expert load max/mean ratio jumped far off its "
+                    "rolling baseline: routing is collapsing onto a few "
+                    "experts — check train/moe_expert_load_dist and the "
+                    "load-balance loss coefficient"},
     # Needs goodput.enabled (the fleet/goodput series only exists when
     # the ledger runs); with goodput off the rule simply never has data,
     # like every rule on a disabled subsystem's metrics.

@@ -879,7 +879,10 @@ class TrainerWorker:
         (reference FlopsCounter inputs, flops_counter.py:15)."""
         import jax
 
-        from areal_tpu.models.transformer import param_count
+        from areal_tpu.models.transformer import (
+            activated_param_count,
+            param_count,
+        )
 
         info: Dict[str, Any] = {
             "n_devices": jax.device_count(),
@@ -897,6 +900,18 @@ class TrainerWorker:
                 "intermediate_dim": cfg.intermediate_dim,
                 "vocab_size": cfg.vocab_size, "is_critic": cfg.is_critic,
                 "n_params": param_count(cfg),
+                # Activated params (per-token compute) — for MoE, only
+                # top_k of num_experts FFNs run per token; the master's
+                # MFU accounting must not count idle expert weights.
+                "n_params_activated": activated_param_count(cfg),
+                "moe": None if getattr(cfg, "moe", None) is None else {
+                    "num_experts": cfg.moe.num_experts,
+                    "top_k": cfg.moe.top_k,
+                    "routed_intermediate_dim":
+                        cfg.moe.routed_intermediate_dim,
+                    "shared_intermediate_dim":
+                        cfg.moe.shared_intermediate_dim,
+                },
                 # Remat recomputes activations in backward → 4× forward
                 # FLOPs instead of 3× (reference checkpoint_activations
                 # factor); the master's MFU math needs to know.

@@ -21,6 +21,7 @@ measured/analytic-roofline (MFU proxy) since the reference publishes no
 absolute tokens/sec (BASELINE.md).
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -336,6 +337,46 @@ def main():
     ring_step_ms, ring_skip = ring_step_time(ring_sched)
     ring_naive_step_ms, _ = ring_step_time("naive")
 
+    # MoE dispatch row (ISSUE 19): one MoE layer's fwd+bwd step time under
+    # the sort-based grouped compute path (default) vs the one-hot einsum
+    # oracle (AREAL_MOE_DISPATCH=einsum), at E=8 experts on this host. The
+    # headline PPO loop above stays DENSE — this row isolates the dispatch
+    # method exactly like the ring row isolates the attention schedule;
+    # `perf_probe moe-bench` sweeps (E, top_k, capacity_factor) shapes.
+    # See docs/benchmarks.md for the method note.
+    from areal_tpu.models import config as mcfg_mod
+    from areal_tpu.models import moe as moe_mod
+
+    moe_cfg = mcfg_mod.MoEConfig(
+        num_experts=8, top_k=2, capacity_factor=2.0,
+        routed_intermediate_dim=cfg.intermediate_dim,
+    )
+    moe_tcfg = dataclasses.replace(cfg, n_layers=1, moe=moe_cfg)
+    moe_dim = cfg.hidden_dim
+    moe_tokens = 4096
+    stacked = moe_mod.init_moe_params(
+        moe_tcfg, jax.random.PRNGKey(0), jnp.float32)
+    moe_params = {k: v[0] for k, v in stacked.items()}  # layer 0 of 1
+    mx = jnp.asarray(rngr.randn(8, moe_tokens // 8, moe_dim)
+                     .astype(np.float32) * 0.1)
+
+    def moe_step_time(dispatch):
+        def loss(lp, x):
+            y, _ = moe_mod.moe_mlp(x, lp, moe_cfg, dispatch=dispatch)
+            return jnp.sum(y * y)
+
+        f = jax.jit(jax.grad(loss))
+        jax.block_until_ready(f(moe_params, mx))  # compile
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            g = f(moe_params, mx)
+        jax.block_until_ready(g)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    moe_step_ms = moe_step_time("grouped")
+    moe_einsum_step_ms = moe_step_time("einsum")
+
     # Roofline context over the bf16 peak of one chip. The 6·N·T train
     # FLOPs estimate and the per-generation peak table live in
     # base/monitor.py — ONE accounting shared with the live trainer's
@@ -343,7 +384,10 @@ def main():
     # the bench number and the live gauges can never drift apart.
     from areal_tpu.base import monitor
 
-    n_params = transformer.param_count(cfg)
+    # Activated params, not total: for MoE geometries only top_k of the
+    # expert FFNs run per token, and 6·N·T over total params would claim
+    # FLOPs that never execute (dense configs: identical to param_count).
+    n_params = transformer.activated_param_count(cfg)
     flops = monitor.train_flops_6nt(n_params, steps * total)
     peak = monitor.device_peak_flops(str(jax.devices()[0]))
     mfu = (flops / dt / n_chips / peak) if peak else 0.0
@@ -368,6 +412,14 @@ def main():
         # Discontinuity key for the ring_* fields (bench_compare skips
         # them when the schedule method changes, like weight_sync_*).
         "ring_schedule_method": f"{ring_sched}-sp{ring_sp}",
+        "moe_num_experts": moe_cfg.num_experts,
+        "moe_top_k": moe_cfg.top_k,
+        "moe_capacity_factor": moe_cfg.capacity_factor,
+        "moe_step_ms": round(moe_step_ms, 3),
+        "moe_einsum_step_ms": round(moe_einsum_step_ms, 3),
+        # Discontinuity key for the moe_* fields (bench_compare skips
+        # them when the dispatch method changes).
+        "moe_dispatch_method": "grouped-vs-einsum",
         # METHOD CHANGE vs r6: the device transport (on-device reshard
         # publish + digest-gated consume) is measured ALONGSIDE the
         # streamed path — weight_sync_latency_s still names the streamed

@@ -2,8 +2,9 @@
 
 Parity targets: realhf/impl/model/modules/moe/ (router aux losses, capacity
 drop, experts) and ReaLMoEConfig (realhf/api/core/model_api.py:294). The
-TPU design dispatches with one-hot einsums into fixed-capacity buffers
-(GShard layout) instead of permute + grouped GEMM.
+default dispatch is the sort-based grouped-GEMM path; the one-hot einsum
+path (GShard layout) is kept as the parity oracle — grouped-vs-einsum and
+expert-parallel parity live in tests/test_moe_dispatch.py.
 """
 
 import jax

@@ -462,8 +462,12 @@ def test_pipeline_moe_aux_parity():
     out, _, aux = jax.jit(fwd)(sp)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3,
                                rtol=2e-3)
-    assert set(aux) == set(aux_ref)
-    for k in aux_ref:
+    # The pipeline carries exactly the SCALAR aux keys (_aux_keys): the
+    # per-expert expert_load histogram is vector-valued and doesn't ride
+    # the scan carries / 1F1B cotangents. The scan path reports it on top.
+    assert set(aux) == set(ppl._aux_keys(cfg))
+    assert set(aux) < set(aux_ref)
+    for k in aux:
         np.testing.assert_allclose(
             float(aux[k]), float(aux_ref[k]), atol=1e-4, rtol=2e-3
         )

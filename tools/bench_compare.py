@@ -25,6 +25,8 @@ with per-field relative tolerances:
   ring_step_ms               lower      25%
   ring_naive_step_ms         lower      25%
   ring_skip_ratio            lower      0% (structural — must not grow)
+  moe_step_ms                lower      25%
+  moe_einsum_step_ms         lower      25%
   train_phases.*             lower      25%
 
 Exit status 0 when every comparable field is within tolerance, 1 on any
@@ -40,7 +42,9 @@ Caveats the gate understands:
    measure different things across a method discontinuity
    (docs/benchmarks.md "Reading the numbers across rounds");
  - likewise when ``ring_schedule_method`` differs (ring schedule or sp
-   width changed), every ``ring_*`` field is skipped.
+   width changed), every ``ring_*`` field is skipped;
+ - likewise when ``moe_dispatch_method`` differs (grouped/einsum method
+   or bench MoE shape changed), every ``moe_*`` field is skipped.
 
 ``--tol field=frac`` overrides a tolerance (e.g. ``--tol value=0.10``,
 ``--tol train_phases.fwd_bwd_s=0.5``); ``--tol default=frac`` sets the
@@ -76,10 +80,17 @@ FIELDS: Dict[str, Tuple[str, float]] = {
     "ring_step_ms": ("lower", 0.25),
     "ring_naive_step_ms": ("lower", 0.25),
     "ring_skip_ratio": ("lower", 0.0),
+    # MoE dispatch (ISSUE 19): one MoE layer's fwd+bwd step time under
+    # the sort-based grouped path (the default) and the one-hot einsum
+    # oracle, at the bench's E=8 shape. Skipped across a
+    # moe_dispatch_method discontinuity like weight_sync_* / ring_*.
+    "moe_step_ms": ("lower", 0.25),
+    "moe_einsum_step_ms": ("lower", 0.25),
 }
 TRAIN_PHASE_SPEC = ("lower", 0.25)
 METHOD_FIELD = "weight_sync_transport_method"
 RING_METHOD_FIELD = "ring_schedule_method"
+MOE_METHOD_FIELD = "moe_dispatch_method"
 
 
 def load_bench(path: str) -> Dict[str, object]:
@@ -130,6 +141,11 @@ def compare(prev: Dict[str, object], cur: Dict[str, object],
         and cur.get(RING_METHOD_FIELD) is not None
         and prev.get(RING_METHOD_FIELD) != cur.get(RING_METHOD_FIELD)
     )
+    moe_method_changed = (
+        prev.get(MOE_METHOD_FIELD) is not None
+        and cur.get(MOE_METHOD_FIELD) is not None
+        and prev.get(MOE_METHOD_FIELD) != cur.get(MOE_METHOD_FIELD)
+    )
     rows: List[Dict[str, object]] = []
     for field in sorted(set(prev) | set(cur)):
         spec = field_spec(field, tol_overrides)
@@ -147,7 +163,8 @@ def compare(prev: Dict[str, object], cur: Dict[str, object],
             rows.append(row)
             continue
         if (method_changed and field.startswith("weight_sync")) or \
-                (ring_method_changed and field.startswith("ring_")):
+                (ring_method_changed and field.startswith("ring_")) or \
+                (moe_method_changed and field.startswith("moe_")):
             row["status"] = "skipped-method-change"
             rows.append(row)
             continue

@@ -41,6 +41,17 @@ Standalone probes (docs/benchmarks.md Tools):
                                       --xla_force_host_platform_device_
                                       count=N) or real chips
                                       (docs/parallelism.md §PP∘SP)
+  moe-bench [E,E,...] [k,k,...] [cf,cf,...]
+                                      sweep MoE dispatch (models/moe.py)
+                                      over (num_experts, top_k,
+                                      capacity_factor): one MoE layer's
+                                      fwd+bwd step time, sort-based
+                                      grouped path (default) vs the
+                                      one-hot einsum oracle, plus the
+                                      routed dropped fraction; runs on
+                                      CPU or real chips
+                                      (docs/parallelism.md §Expert
+                                      parallelism)
 
 Live-fleet commands (docs/observability.md; name-resolve root via
 AREAL_NAME_RESOLVE_ROOT when not the default):
@@ -1238,6 +1249,75 @@ def ring_bench(sp_list=None, seq_list=None, reps: int = 3) -> None:
                   f"{zz[1]:>10.3f}")
 
 
+def moe_bench(e_list=None, k_list=None, cf_list=None, reps: int = 3,
+              n_tokens: int = 4096, dim: int = 256) -> None:
+    """Sweep the MoE dispatch paths (models/moe.py) over (num_experts,
+    top_k, capacity_factor): one MoE layer's fwd+bwd step time for the
+    sort-based grouped-GEMM path (the default) vs the one-hot einsum
+    oracle (AREAL_MOE_DISPATCH=einsum), plus the fraction of routed
+    assignments dropped at the capacity boundary. The einsum oracle pays
+    O(tokens x E x capacity) ~ O(k*cf*tokens^2) one-hot dispatch/combine
+    contractions plus dense [E, C] buffers; grouped replaces them with a
+    sort + ragged GEMMs. Caveat: ragged_dot's CPU lowering scales with E,
+    so host-mesh sweeps understate the grouped win at large E — the TPU
+    kernel does not."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from areal_tpu.models import config as mcfg
+    from areal_tpu.models import moe as moe_mod
+
+    e_list = e_list or [4, 8, 16, 32]
+    k_list = k_list or [2]
+    cf_list = cf_list or [1.0, 2.0]
+    print(f"[moe-bench] {len(jax.devices())} "
+          f"{jax.devices()[0].platform} devices; tokens={n_tokens} "
+          f"dim={dim} ffn={dim * 2}; fwd+bwd one MoE layer, "
+          f"grouped (active) vs einsum (oracle)")
+    print(f"[moe-bench] {'E':>4} {'top_k':>5} {'cap_f':>5} "
+          f"{'grouped_ms':>10} {'einsum_ms':>10} {'speedup':>8} "
+          f"{'dropped':>8}")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, n_tokens // 8, dim)
+                    .astype(np.float32) * 0.1)
+    for E in e_list:
+        for k in k_list:
+            if k > E:
+                continue
+            for cf in cf_list:
+                moe = mcfg.MoEConfig(num_experts=E, top_k=k,
+                                     capacity_factor=cf,
+                                     routed_intermediate_dim=dim * 2)
+                tcfg = mcfg.tiny_config(hidden_dim=dim, n_q_heads=4,
+                                        n_kv_heads=2, moe=_dc.asdict(moe))
+                stacked = moe_mod.init_moe_params(
+                    _dc.replace(tcfg, n_layers=1), jax.random.PRNGKey(0),
+                    jnp.float32)
+                lp = {name: w[0] for name, w in stacked.items()}
+                res = {}
+                for disp in ("grouped", "einsum"):
+                    def loss(lp, x, disp=disp):
+                        y, aux = moe_mod.moe_mlp(x, lp, moe, dispatch=disp)
+                        return jnp.sum(y * y), aux["dropped_frac"]
+
+                    f = jax.jit(jax.grad(loss, has_aux=True))
+                    _, dropped = f(lp, x)
+                    jax.block_until_ready(dropped)  # compile
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        g, dropped = f(lp, x)
+                    jax.block_until_ready(g)
+                    res[disp] = ((time.perf_counter() - t0) / reps * 1e3,
+                                 float(dropped))
+                gr, ei = res["grouped"], res["einsum"]
+                print(f"[moe-bench] {E:>4} {k:>5} {cf:>5.2f} "
+                      f"{gr[0]:>10.2f} {ei[0]:>10.2f} "
+                      f"{ei[0] / max(gr[0], 1e-9):>7.2f}x {gr[1]:>8.3f}")
+
+
 def _dispatch_fleet_commands(argv) -> bool:
     if not argv or argv[0] not in ("scrape", "decode-bench", "trace",
                                    "flight-dump", "packfill", "blocksweep",
@@ -1245,7 +1325,8 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "fleet-status", "drain", "cordon",
                                    "uncordon", "reward-bench", "alerts",
                                    "silence", "goodput", "reshard-bench",
-                                   "ring-bench", "spool-status"):
+                                   "ring-bench", "moe-bench",
+                                   "spool-status"):
         return False
     cmd = argv[0]
     try:
@@ -1317,6 +1398,15 @@ def _dispatch_fleet_commands(argv) -> bool:
                 [int(x) for x in argv[1].split(",")] if len(argv) > 1
                 else None,
                 [int(x) for x in argv[2].split(",")] if len(argv) > 2
+                else None,
+            )
+        elif cmd == "moe-bench":
+            moe_bench(
+                [int(x) for x in argv[1].split(",")] if len(argv) > 1
+                else None,
+                [int(x) for x in argv[2].split(",")] if len(argv) > 2
+                else None,
+                [float(x) for x in argv[3].split(",")] if len(argv) > 3
                 else None,
             )
         elif cmd == "profile-trigger":
