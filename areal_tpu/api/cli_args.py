@@ -33,6 +33,7 @@ from areal_tpu.api.model import GenerationHyperparameters  # noqa: F401
 # never drags in jax/optax (CPU-only children, `--help`).
 from areal_tpu.api.train_config import (  # noqa: F401
     AutoscaleConfig,
+    CompileWatchConfig,
     DurabilityConfig,
     ExperimentSaveEvalControl,
     FaultToleranceConfig,
@@ -233,6 +234,15 @@ class BaseExperimentConfig:
     # on critical alerts, and opt-in master pause.
     sentinel: SentinelConfig = dataclasses.field(
         default_factory=SentinelConfig
+    )
+    # Compile & HBM observatory (docs/observability.md §Compile & memory):
+    # off by default — `compile_watch.enabled=true` (with telemetry on)
+    # wraps the fleet's jit entry points in compile-event tracing with
+    # recompile-storm detection, samples per-device HBM gauges with
+    # high-water marks around the big allocators, and arms the
+    # recompile_storm / hbm_pressure / compile_stall sentinel rules.
+    compile_watch: CompileWatchConfig = dataclasses.field(
+        default_factory=CompileWatchConfig
     )
     # Generation-fleet serving engine (docs/serving.md): off by default —
     # `serving.enabled=true` turns on request-class admission control,
@@ -643,6 +653,56 @@ def validate_config(cfg) -> None:
                 f"goodput.peak_flops_override={gp.peak_flops_override} "
                 f"must be >= 0 (0 = auto-detect from the device kind)"
             )
+    cw = getattr(cfg, "compile_watch", None)
+    if cw is not None and getattr(cw, "enabled", False):
+        tel = getattr(cfg, "telemetry", None)
+        if tel is None or not getattr(tel, "enabled", False):
+            raise ConfigError(
+                "compile_watch.enabled=true requires telemetry.enabled=true: "
+                "compile events and HBM gauges export through the telemetry "
+                "registry and roll up in the master's aggregator — without "
+                "telemetry there is nowhere to record them "
+                "(docs/observability.md §Compile & memory)"
+            )
+        if getattr(cw, "storm_warmup_calls", 16) < 1:
+            raise ConfigError(
+                f"compile_watch.storm_warmup_calls="
+                f"{cw.storm_warmup_calls} must be >= 1 (a zero warmup "
+                f"would flag every cold-start compile as a storm)"
+            )
+        if getattr(cw, "mem_sample_interval_secs", 10.0) < 0:
+            raise ConfigError(
+                f"compile_watch.mem_sample_interval_secs="
+                f"{cw.mem_sample_interval_secs} must be >= 0"
+            )
+        serving = getattr(cfg, "serving", None)
+        if serving is not None and getattr(serving, "enabled", False):
+            # Unify compiled-shape accounting across serving and training:
+            # the serving ShapeBucketPolicy caps its admitted grid set at
+            # serving.max_compiled_shapes, but the trainer's microbatch
+            # fill sweep contributes its own [R, L] shapes to the SAME
+            # compile/distinct_shapes family. Cross-check the worst case
+            # at parse time with the sweep's own bound (shared code, not
+            # replicated numbers) so an operator who tightened
+            # max_compiled_shapes learns which OTHER field defeats it.
+            from areal_tpu.backend.microbatch import (
+                worst_case_row_candidates,
+            )
+
+            max_shapes = int(getattr(serving, "max_compiled_shapes", 0))
+            trainer_cands = worst_case_row_candidates()
+            if 0 < max_shapes < trainer_cands:
+                raise ConfigError(
+                    f"serving.max_compiled_shapes={max_shapes} is below "
+                    f"the trainer fill sweep's worst-case candidate count "
+                    f"({trainer_cands}, from backend/microbatch.py "
+                    f"worst_case_row_candidates): the trainer alone could "
+                    f"exceed the shape budget the serving policy enforces. "
+                    f"Raise serving.max_compiled_shapes to at least "
+                    f"{trainer_cands}, or coarsen the trainer's "
+                    f"fill_bucket (actor.backend fill_bucket) to shrink "
+                    f"the sweep."
+                )
     sn = getattr(cfg, "sentinel", None)
     if sn is not None and getattr(sn, "enabled", False):
         tel = getattr(cfg, "telemetry", None)
@@ -666,9 +726,15 @@ def validate_config(cfg) -> None:
         from areal_tpu.system.sentinel import rules_from_config
 
         try:
-            rules_from_config(sn, durability_enabled=getattr(
-                getattr(cfg, "durability", None), "enabled", False
-            ))
+            rules_from_config(
+                sn,
+                durability_enabled=getattr(
+                    getattr(cfg, "durability", None), "enabled", False
+                ),
+                compile_watch_enabled=getattr(
+                    getattr(cfg, "compile_watch", None), "enabled", False
+                ),
+            )
         except ValueError as e:
             raise ConfigError(f"invalid sentinel rule pack: {e}") from None
     dur = getattr(cfg, "durability", None)

@@ -137,6 +137,35 @@ class GoodputConfig:
 
 
 @dataclasses.dataclass
+class CompileWatchConfig:
+    """Compile & HBM observatory (base/compile_watch.py +
+    system/memwatch.py, docs/observability.md §Compile & memory).
+
+    Off by default: with ``enabled=False`` every ``watched_jit`` site
+    gets the raw jitted function back (zero wrappers, zero per-call
+    work), no device memory_stats poll ever runs, and the Prometheus
+    scrape is bit-identical to a build without the observatory. Enabled
+    (requires ``telemetry.enabled``), every chip-bearing worker records
+    per-function compile events (trigger shapes, elapsed seconds,
+    cumulative counts, a recompile-storm detector), publishes the
+    compile-inflight flag its HeartbeatThread exports so sentinel absence
+    rules become compile-aware, samples per-device HBM gauges with
+    high-water marks around the big allocators, and the master derives
+    fleet rollups plus the recompile_storm / hbm_pressure / compile_stall
+    sentinel rules."""
+
+    enabled: bool = False
+    # Calls without a new compiled shape before a function counts as
+    # shape-STABLE; a new shape after that is a storm event (the signal
+    # the recompile_storm sentinel rule rates). Lower it in tests.
+    storm_warmup_calls: int = 16
+    # Min interval between device memory_stats polls (samples piggyback
+    # on worker cadences — the trainer step loop, the generation
+    # server's metrics endpoint — so this bounds poll cost, not wakeups).
+    mem_sample_interval_secs: float = 10.0
+
+
+@dataclasses.dataclass
 class SentinelConfig:
     """Training-health sentinel (system/sentinel.py,
     docs/observability.md §Alerting).

@@ -29,15 +29,16 @@ logger = logging.getLogger("apps.launcher")
 # experiment spawns 4+ JAX processes that would otherwise each recompile the
 # same graphs from scratch — on a busy host that made the e2e launch a
 # 165-420s coin flip (VERDICT r2 weak #4). Override with
-# AREAL_COMPILATION_CACHE; set to "" to disable.
-DEFAULT_COMPILATION_CACHE = os.path.expanduser(
-    "~/.cache/areal_tpu/jax_compilation_cache"
+# AREAL_COMPILATION_CACHE; set to "" to disable. The default path lives in
+# base/compile_watch.py so the observatory's cache-hit/miss probe watches the
+# same directory the launcher arms.
+from areal_tpu.base.compile_watch import (  # noqa: E402
+    DEFAULT_COMPILATION_CACHE, compilation_cache_dir,
 )
 
 
 def enable_compilation_cache() -> None:
-    path = os.environ.get("AREAL_COMPILATION_CACHE",
-                          DEFAULT_COMPILATION_CACHE)
+    path = compilation_cache_dir()
     if not path:
         return
     import jax

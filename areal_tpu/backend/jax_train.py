@@ -36,12 +36,13 @@ from areal_tpu.api.model import (
     register_backend,
 )
 from areal_tpu.backend import microbatch as mbu
-from areal_tpu.base import logging, telemetry
+from areal_tpu.base import compile_watch, logging, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.parallel import pipeline as ppl
 from areal_tpu.parallel import sharding as psh
+from areal_tpu.system import memwatch
 
 logger = logging.getLogger("backend.jax")
 
@@ -235,7 +236,9 @@ class JaxTrainEngine(TrainableEngine):
         if opt_cfg is not None:
             total = ft_spec.total_train_steps if ft_spec is not None else 1000
             self.tx, self.lr_schedule = build_optimizer(opt_cfg, total)
-            self.opt_state = jax.jit(self.tx.init)(self.params)
+            self.opt_state = compile_watch.watched_jit(
+                "train/opt_init", jax.jit(self.tx.init)
+            )(self.params)
         self._grad_fns: Dict[int, Callable] = {}
         self._fwd_fns: Dict[int, Callable] = {}
         self._apply_fn = None
@@ -443,7 +446,9 @@ class JaxTrainEngine(TrainableEngine):
                 return loss, stats, grads
 
             donate = (6,) if with_carry else ()
-            self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
+            self._grad_fns[key] = compile_watch.watched_jit(
+                "train/grad", jax.jit(f, donate_argnums=donate)
+            )
         return self._grad_fns[key]
 
     def _get_apply_fn(self, skip_rule) -> Callable:
@@ -493,7 +498,9 @@ class JaxTrainEngine(TrainableEngine):
         # optimizer's f32 transients reuse those 2 bytes/param in place —
         # measured on the 16G bench chip, withdrawing the grads donation
         # OOMs the apply step.
-        self._grad_fns[key] = jax.jit(f, donate_argnums=(0, 1, 2))
+        self._grad_fns[key] = compile_watch.watched_jit(
+            "train/apply", jax.jit(f, donate_argnums=(0, 1, 2))
+        )
         return self._grad_fns[key]
 
     # -------------- upload-once uniform batches --------------
@@ -563,8 +570,11 @@ class JaxTrainEngine(TrainableEngine):
         their drift never retraces."""
         key = ("prep", prep_key, ub.n_mbs, ub.R)
         if key not in self._grad_fns:
-            self._grad_fns[key] = jax.jit(
-                lambda grids, seq, sc: prep_fn(grids, seq, ub.R, sc)
+            self._grad_fns[key] = compile_watch.watched_jit(
+                "train/prep",
+                jax.jit(
+                    lambda grids, seq, sc: prep_fn(grids, seq, ub.R, sc)
+                ),
             )
         sc = {
             k: jnp.asarray(v, jnp.float32) for k, v in (scalars or {}).items()
@@ -636,7 +646,9 @@ class JaxTrainEngine(TrainableEngine):
                 return loss, stats, grads
 
             donate = (9,) if with_carry else ()
-            self._grad_fns[key] = jax.jit(f, donate_argnums=donate)
+            self._grad_fns[key] = compile_watch.watched_jit(
+                "train/grad_sliced", jax.jit(f, donate_argnums=donate)
+            )
         return self._grad_fns[key]
 
     def train_uniform(
@@ -678,7 +690,8 @@ class JaxTrainEngine(TrainableEngine):
                     jax.random.PRNGKey(self.opt_step_count), ub.n_mbs
                 ),
             )
-        with telemetry.span("train/fwd_bwd", n_mbs=len(idxs)):
+        with telemetry.span("train/fwd_bwd", n_mbs=len(idxs)), \
+                memwatch.watermark("train/fwd_bwd"):
             for i, w in zip(idxs, weights):
                 denom = total_w if glob else w
                 fn = self._get_sliced_grad_fn(
@@ -843,7 +856,8 @@ class JaxTrainEngine(TrainableEngine):
             jax.random.PRNGKey(self.opt_step_count)
             if self._router_jitter else None
         )
-        with telemetry.span("train/fwd_bwd", n_mbs=n_mbs):
+        with telemetry.span("train/fwd_bwd", n_mbs=n_mbs), \
+                memwatch.watermark("train/fwd_bwd"):
             for i, (mb, w) in enumerate(zip(mbs, weights)):
                 denom = total_w if glob else w
                 batch = self._device_batch(mb)
@@ -1029,7 +1043,9 @@ class JaxTrainEngine(TrainableEngine):
                 return (post_hook(out, loss_batch)
                         if post_hook is not None else out)
 
-            self._fwd_fns[key] = jax.jit(f)
+            self._fwd_fns[key] = compile_watch.watched_jit(
+                "train/forward", jax.jit(f)
+            )
         fn = self._fwd_fns[key]
         outs = []
         for mb in mbs:

@@ -51,6 +51,34 @@ class MicroBatch:
         return int(sum(self.layout.seqlens))
 
 
+# The fill sweep below bounds its candidate row lengths to
+# ``min(cap, max(2*base, 64*fill_bucket))`` stepped by ``fill_bucket`` —
+# at most this many distinct L values regardless of the token budget.
+FILL_SWEEP_MAX_CANDIDATES = 64
+
+
+def worst_case_row_candidates(
+    length_bucket: int = 128,
+    fill_bucket: Optional[int] = None,
+    max_tokens_per_mb: Optional[int] = None,
+) -> int:
+    """Upper bound on distinct candidate row lengths the fill sweep in
+    :func:`split_into_microbatches` can ever emit — i.e. the worst-case
+    contribution of trainer ``[R, L]`` packed grids to the
+    ``compile/distinct_shapes`` family. Pure arithmetic (no jax): shared
+    by ``cli_args.validate_config``'s cross-check against
+    ``serving.max_compiled_shapes`` so the parse-time check and the
+    runtime sweep agree by construction."""
+    if fill_bucket is None:
+        fill_bucket = min(length_bucket, 128)
+    fill_bucket = max(int(fill_bucket), 1)
+    n = FILL_SWEEP_MAX_CANDIDATES
+    if max_tokens_per_mb:
+        # cap also bounds hi: at most ceil(cap / fill_bucket) multiples fit.
+        n = min(n, -(-int(max_tokens_per_mb) // fill_bucket))
+    return max(n, 1)
+
+
 def split_into_microbatches(
     sample: SequenceSample,
     mb_spec: MicroBatchSpec,

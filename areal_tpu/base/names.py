@@ -149,6 +149,21 @@ def worker_heartbeat_root(experiment: str, trial: str) -> str:
     return f"{_base(experiment, trial)}/heartbeat/"
 
 
+def compile_inflight(experiment: str, trial: str, worker: str) -> str:
+    """Compile-in-flight flag of one worker: JSON {ts}, rewritten every
+    heartbeat interval by the worker's HeartbeatThread while its
+    CompileWatch reports a jit compile in progress, deleted when the
+    compile drains (system/worker_base.py, base/compile_watch.py). The
+    sentinel's absence rules read this to tell "wedged" apart from
+    "legitimately compiling" instead of hiding behind a blanket grace
+    (system/sentinel.py trainer_stalled)."""
+    return f"{_base(experiment, trial)}/compile_inflight/{worker}"
+
+
+def compile_inflight_root(experiment: str, trial: str) -> str:
+    return f"{_base(experiment, trial)}/compile_inflight/"
+
+
 def autoscale_plan(experiment: str, trial: str) -> str:
     """Fleet-size directive published by the gserver manager's autoscale
     loop (JSON {target, dynamic, ts, reason}): ``dynamic`` is how many

@@ -977,6 +977,7 @@ class TelemetryAggregator:
     def _ingest(self, payload: Dict[str, Any]) -> None:
         worker = f"{payload.get('worker_kind', '?')}:" \
                  f"{payload.get('worker_index', 0)}"
+        self._derive_hbm_utilization(payload)
         with self._state_lock:
             prev = self.state.get(worker)
             spans = payload.get("spans", [])
@@ -1057,6 +1058,36 @@ class TelemetryAggregator:
                 except Exception:  # noqa: BLE001 — TB is best-effort
                     pass
 
+    @staticmethod
+    def _derive_hbm_utilization(payload: Dict[str, Any]) -> None:
+        """Inject per-device ``hbm/utilization{device=i}`` =
+        bytes_in_use / limit_bytes into a snapshot that carries both
+        memwatch gauges (system/memwatch.py) — derived HERE because only
+        the aggregator-side series feeds the ``hbm_pressure`` sentinel
+        rule as a ready-made ratio. No hbm gauges in the payload ⇒ no
+        mutation at all: with the observatory disabled the merged scrape
+        stays bit-identical."""
+        gauges = payload.get("gauges")
+        if not gauges:
+            return
+        limits = {}
+        for k, v in gauges.items():
+            base, labels = _metric_key_labels(k)
+            if base == "hbm/limit_bytes" and labels \
+                    and isinstance(v, (int, float)) and v > 0:
+                limits[labels.get("device")] = float(v)
+        if not limits:
+            return
+        derived = {}
+        for k, v in gauges.items():
+            base, labels = _metric_key_labels(k)
+            dev = labels.get("device") if labels else None
+            if base == "hbm/bytes_in_use" and dev in limits \
+                    and isinstance(v, (int, float)):
+                derived[f"hbm/utilization{{device={dev}}}"] = \
+                    float(v) / limits[dev]
+        gauges.update(derived)
+
     def _loop(self) -> None:
         while not self._closing.is_set():
             try:
@@ -1115,6 +1146,32 @@ class TelemetryAggregator:
             fg = goodput.registry.snapshot(reset=False)
             if fg["gauges"]:
                 rows["fleet:0"] = fg
+        # Fleet rollups for the compile & HBM observatory: the total
+        # compile seconds burned across every worker, and the worst HBM
+        # utilization per worker kind (the capacity-planning numbers an
+        # operator wants without a PromQL layer). Appended ONLY when the
+        # source series exist — with compile_watch disabled nothing is
+        # added and the scrape stays bit-identical.
+        compile_secs = 0.0
+        any_compile = False
+        hbm_util: Dict[str, float] = {}
+        for worker, st in rows.items():
+            kind = worker.partition(":")[0]
+            for k, v in st.get("counters", {}).items():
+                if _metric_key_labels(k)[0] == "compile/secs":
+                    compile_secs += float(v)
+                    any_compile = True
+            for k, v in st.get("gauges", {}).items():
+                if _metric_key_labels(k)[0] == "hbm/utilization":
+                    hbm_util[kind] = max(hbm_util.get(kind, 0.0), float(v))
+        if any_compile:
+            ls = _prom_labels({"worker_kind": "fleet", "worker_index": "0"})
+            add("areal_compile_secs_total", "counter",
+                f"areal_compile_secs_total{ls} {compile_secs:g}")
+        for kind in sorted(hbm_util):
+            ls = _prom_labels({"worker_kind": kind, "worker_index": "fleet"})
+            add("areal_hbm_utilization", "gauge",
+                f"areal_hbm_utilization{ls} {hbm_util[kind]:g}")
         for worker, st in sorted(rows.items()):
             kind, _, idx = worker.partition(":")
             labels = {"worker_kind": kind, "worker_index": idx}

@@ -66,6 +66,7 @@ class AsyncPPOMATHConfig(PPOMATHConfig):
                 serving=self.serving,
                 telemetry=self._telemetry(),
                 goodput=self.goodput,
+                compile_watch=self.compile_watch,
                 keepalive_ttl_secs=self.fault_tolerance.keepalive_ttl_secs,
             )
             for i in range(n_gen)

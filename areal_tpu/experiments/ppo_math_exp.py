@@ -356,6 +356,7 @@ class PPOMATHConfig(BaseExperimentConfig):
             weight_sync=weight_sync,
             telemetry=self._telemetry(),
             goodput=self.goodput,
+            compile_watch=self.compile_watch,
             reward_service=self.reward_service,
             durability=self.durability,
         )
@@ -401,6 +402,9 @@ class PPOMATHConfig(BaseExperimentConfig):
             sentinel=self.sentinel,
             # Fleet-goodput stitching rides in the same aggregator.
             goodput=self.goodput,
+            # Arms the compile-aware sentinel rules (recompile_storm,
+            # hbm_pressure, compile_stall) when the observatory is on.
+            compile_watch=self.compile_watch,
             # Arms the sentinel's sample_loss rule when the durable
             # spool is on (the freed-id forwarding is the ack trigger).
             durability=self.durability,

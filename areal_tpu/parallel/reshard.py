@@ -243,11 +243,16 @@ def reshard_pytree(
     if plan.groups and dist.is_multiprocess():
         import jax
 
+        from areal_tpu.base import compile_watch
+
         out = dict(flat_src)
         for group in plan.groups:
-            moved = jax.jit(
-                lambda *xs: xs,
-                out_shardings=tuple(flat_dst[n] for n in group),
+            moved = compile_watch.watched_jit(
+                "reshard/identity",
+                jax.jit(
+                    lambda *xs: xs,
+                    out_shardings=tuple(flat_dst[n] for n in group),
+                ),
             )(*(flat_src[n] for n in group))
             jax.block_until_ready(moved)
             out.update(zip(group, moved))
@@ -367,13 +372,19 @@ def publish_device(
     manager's transport auto-detection routes fanouts here. Returns the
     publication (its ``digest`` is what consumers will be handed)."""
     from areal_tpu.base import name_resolve, names
+    from areal_tpu.system import memwatch
 
     t0 = time.monotonic()
     if target_shardings is None:
         target_shardings = shardings_like(params, model_shardings(None, None))
     else:
         target_shardings = shardings_like(params, target_shardings)
-    new, plan = reshard_pytree(params, target_shardings, group_mb=group_mb)
+    # The publish is a 2x-params moment on the trainer mesh (source +
+    # resharded copies live until the old publication drops): record the
+    # measured high-water mark the group_mb headroom math budgets for.
+    with memwatch.watermark("reshard/publish"):
+        new, plan = reshard_pytree(params, target_shardings,
+                                   group_mb=group_mb)
     flat = _flatten(new)
     manifest = build_manifest(flat)
     digest = manifest_digest(manifest, version)
@@ -465,11 +476,14 @@ def consume_device(
                 f"tensor {name!r}: published shape "
                 f"{pub_names[name]['shape']} != live {list(old.shape)}"
             )
-    new, plan = reshard_pytree(
-        pub.params,
-        _unflatten({n: v.sharding for n, v in live_flat.items()}),
-        group_mb=group_mb,
-    )
+    from areal_tpu.system import memwatch
+
+    with memwatch.watermark("reshard/consume"):
+        new, plan = reshard_pytree(
+            pub.params,
+            _unflatten({n: v.sharding for n, v in live_flat.items()}),
+            group_mb=group_mb,
+        )
     # The publication travels in the trainer's compute dtype; a consumer
     # holding a different dtype casts on device (the streamed path casts
     # on the h2d upload — same contract, no host hop here).

@@ -43,14 +43,17 @@ import numpy as np
 
 from areal_tpu.api.model import GenerationHyperparameters
 from areal_tpu.api.train_config import (
+    CompileWatchConfig,
     GoodputConfig,
     ServingConfig,
     TelemetryConfig,
 )
+from areal_tpu.base import compile_watch as compile_watch_mod
 from areal_tpu.base import logging, name_resolve, names, network, telemetry
 from areal_tpu.models import generate as genmod
 from areal_tpu.models import transformer  # noqa: F401 (engine deps)
 from areal_tpu.system import goodput as goodput_mod
+from areal_tpu.system import memwatch as memwatch_mod
 from areal_tpu.system import serving as serving_mod
 
 logger = logging.getLogger("system.genserver")
@@ -106,6 +109,14 @@ class GenerationServerConfig:
     # from discovery instead of being probed forever. 0 falls back to
     # the supervisor-set AREAL_WORKER_KEEPALIVE_TTL env.
     keepalive_ttl_secs: float = 0.0
+    # Compile & HBM observatory (base/compile_watch.py +
+    # system/memwatch.py): per-INSTANCE watches bound to this server's
+    # telemetry (same reason telemetry itself is per-instance here — the
+    # gen-fleet process hosts many servers plus the manager). Off by
+    # default: raw genmod entry points, no device polls.
+    compile_watch: CompileWatchConfig = dataclasses.field(
+        default_factory=CompileWatchConfig
+    )
 
 
 class _Pending:
@@ -208,6 +219,36 @@ class GenerationServer:
                 mfu_name="genserver/decode_mfu",
                 context=f"genserver {cfg.server_id}",
             )
+        # Compile & HBM observatory: per-instance watches bound to THIS
+        # server's telemetry (several servers share the gen-fleet
+        # process). The jit entry points below route through the
+        # wrappers; NULL when disabled, so the hot path pays one extra
+        # plain call at most.
+        arm_watch = cfg.compile_watch.enabled and cfg.telemetry.enabled
+        self.compile_watch = (
+            compile_watch_mod.CompileWatch(
+                self.telemetry,
+                storm_warmup_calls=cfg.compile_watch.storm_warmup_calls,
+                cache_dir=compile_watch_mod.compilation_cache_dir(),
+            ) if arm_watch else compile_watch_mod.NULL
+        )
+        self.memwatch = (
+            memwatch_mod.MemWatch(
+                self.telemetry,
+                sample_interval_secs=(
+                    cfg.compile_watch.mem_sample_interval_secs
+                ),
+            ) if arm_watch else memwatch_mod.NULL
+        )
+        self._prefill_fn = self.compile_watch.wrap(
+            "genserver/prefill", genmod.prefill_state
+        )
+        self._decode_fn = self.compile_watch.wrap(
+            "genserver/decode", genmod.decode_chunk_rows
+        )
+        self._extend_fn = self.compile_watch.wrap(
+            "genserver/extend", genmod.extend_state
+        )
         # The serving engine owns queueing, batch formation, retained-KV
         # lifecycle, and the compile-shape set; this server's handlers and
         # decode loop delegate those decisions (docs/serving.md).
@@ -323,7 +364,7 @@ class GenerationServer:
             shapes.observe("prefill", B_pad, padded.shape[1], S)
             t_prefill_wall = time.time()
             t_prefill = time.monotonic()
-            st = genmod.prefill_state(
+            st = self._prefill_fn(
                 params, self.model_cfg, jnp.asarray(padded),
                 jnp.asarray(plens), S,
             )
@@ -379,7 +420,7 @@ class GenerationServer:
             from areal_tpu.ops.sampling import sampling_from_gconfigs
 
             shapes.observe("decode", rows, S, chunk)
-            new_state, out = genmod.decode_chunk_rows(
+            new_state, out = self._decode_fn(
                 params, self.model_cfg, stacked, done, sub,
                 sampling_from_gconfigs(
                     [p.gconfig for p in group]
@@ -529,7 +570,7 @@ class GenerationServer:
             padded = np.full((1, T), cfg.pad_token_id, np.int32)
             padded[0, :len(suffix)] = suffix
             shapes.observe("extend", 1, T, st["kv_k"].shape[2])
-            row_states[id(p)] = genmod.extend_state(
+            row_states[id(p)] = self._extend_fn(
                 params, self.model_cfg, st, jnp.asarray(padded),
                 jnp.asarray([len(suffix)], jnp.int32),
             )
@@ -746,13 +787,8 @@ class GenerationServer:
         only replaces ``self.params`` after the publisher's digest verifies
         the complete stream — a torn, reordered, or corrupted transfer
         raises before anything live is touched."""
-        import jax
-
-        from areal_tpu.models.hf import flatten_pytree, unflatten_pytree
-        from areal_tpu.system.weight_stream import (
-            WeightStreamConsumer,
-            WeightStreamError,
-        )
+        from areal_tpu.models.hf import flatten_pytree
+        from areal_tpu.system.weight_stream import WeightStreamConsumer
 
         old_flat = flatten_pytree(self.params)
         consumer = WeightStreamConsumer(
@@ -761,6 +797,19 @@ class GenerationServer:
             **({} if timeout_secs is None
                else {"timeout_secs": timeout_secs}),
         )
+        # The shadow-pytree swap is the server's HBM high-water mark: old
+        # + new params coexist until the verified swap. The watermark
+        # gauge is the measured number docs/weight_sync.md budgets 2x
+        # params for.
+        with self.memwatch.watermark("genserver/shadow_swap"):
+            return self._stream_shadow(consumer, version, old_flat)
+
+    def _stream_shadow(self, consumer, version: int, old_flat):
+        import jax
+
+        from areal_tpu.models.hf import unflatten_pytree
+        from areal_tpu.system.weight_stream import WeightStreamError
+
         try:
             manifest = consumer.fetch_manifest(version)
             shadow = {}
@@ -817,11 +866,12 @@ class GenerationServer:
 
         from areal_tpu.parallel import reshard as rsh
 
-        new = rsh.consume_device(
-            self.cfg.experiment, self.cfg.trial, role,
-            version, digest, self.params,
-        )
-        jax.block_until_ready(new)
+        with self.memwatch.watermark("genserver/device_consume"):
+            new = rsh.consume_device(
+                self.cfg.experiment, self.cfg.trial, role,
+                version, digest, self.params,
+            )
+            jax.block_until_ready(new)
         return new
 
     async def handle_update_weights(self, request):
@@ -921,6 +971,9 @@ class GenerationServer:
 
     def _metrics_dict(self) -> Dict[str, Any]:
         self.ledger.poll()  # scrape-time freshness for the idle state
+        # HBM gauges piggyback on the scrape cadence (rate-limited inside
+        # the watch; NULL when the observatory is off).
+        self.memwatch.sample()
         dt = max(time.monotonic() - self._t_start, 1e-6)
         d = {
             "generated_tokens": self._tokens_out,
@@ -1022,6 +1075,9 @@ class GenerationServer:
                 self.cfg.experiment, self.cfg.trial,
                 f"genserver_{self.cfg.server_id}",
                 interval=default_heartbeat_interval(ttl),
+                # Compile-aware liveness: publish names.compile_inflight
+                # while prefill/decode/extend compile a fresh shape.
+                inflight_fn=self.compile_watch.inflight,
             )
             self._hb.lease(key, url, ttl)
         logger.info(f"generation server {self.cfg.server_id} at {url}"
@@ -1043,5 +1099,7 @@ class GenerationServer:
         if getattr(self, "_hb", None) is not None:
             self._hb.close()
         self.ledger.flush()
+        self.memwatch.close()
+        self.compile_watch.close()
         self.telemetry.close()
         await self._runner_obj.cleanup()

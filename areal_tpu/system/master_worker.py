@@ -42,6 +42,7 @@ logger = logging.getLogger("system.master")
 # Canonical home is the dependency-free api.train_config; re-exported here
 # because this module historically defined it.
 from areal_tpu.api.train_config import (  # noqa: E402,F401
+    CompileWatchConfig,
     DurabilityConfig,
     ExperimentSaveEvalControl,
     GoodputConfig,
@@ -89,6 +90,13 @@ class MasterWorkerConfig:
     # sample_loss absence rule on spool acks.
     durability: DurabilityConfig = dataclasses.field(
         default_factory=DurabilityConfig
+    )
+    # Compile & HBM observatory (base/compile_watch.py): the master's
+    # interest is rule-pack arming — with the observatory on, the
+    # sentinel gains the recompile_storm / hbm_pressure / compile_stall
+    # pack over the series the chip-bearing workers export.
+    compile_watch: CompileWatchConfig = dataclasses.field(
+        default_factory=CompileWatchConfig
     )
     # recover checkpoints (RecoverInfo + trainer train-state) live here
     recover_dir: str = ""
@@ -178,6 +186,9 @@ class MasterWorker:
                     rules=rules_from_config(
                         self.cfg.sentinel,
                         durability_enabled=self.cfg.durability.enabled,
+                        # Same gating story for the compile/HBM pack: its
+                        # series exist only with the observatory armed.
+                        compile_watch_enabled=self.cfg.compile_watch.enabled,
                     ),
                     alerts_path=(self.cfg.sentinel.alerts_path
                                  or os.path.join(log_dir, "alerts.jsonl")),

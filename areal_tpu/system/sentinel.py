@@ -162,6 +162,18 @@ METRIC_CATALOG = frozenset({
     "spool/backpressure_waits", "spool/replay_stale_dropped",
     "spool/duplicate_dropped", "buffer/duplicate_dropped",
     "stream/push_blocked",
+    # compile & HBM observatory (base/compile_watch.py,
+    # system/memwatch.py; docs/observability.md §Compile & memory):
+    # per-fn compile events/seconds/shape counts, the process-wide
+    # in-flight gauge the compile-aware absence rules read, persistent
+    # cache hit/miss counters, and per-device HBM gauges plus the
+    # aggregator-derived utilization series.
+    "compile/events", "compile/secs", "compile/storm_events",
+    "compile/cache_hits", "compile/cache_misses", "compile/inflight",
+    "compile/distinct_shapes",
+    "hbm/bytes_in_use", "hbm/peak_bytes", "hbm/limit_bytes",
+    "hbm/watermark_bytes", "hbm/utilization",
+    "hbm/memory_stats_unavailable",
 })
 
 _DUR_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*(ms|s|m|h)?\s*$")
@@ -200,6 +212,13 @@ class Rule:
     agg: str = "max"  # across workers/labels reporting the metric
     action: str = "evidence"  # "pause" additionally pauses the master
     description: str = ""
+    # Absence-rule suppressor: while this metric family has a recent
+    # nonzero reading (or the matching names.compile_inflight flag is
+    # fresh), the absence predicate reports healthy instead of counting
+    # toward 'for'. The compile-aware liveness story: trainer_stalled
+    # sets it to compile/inflight so a long warmup XLA compile doesn't
+    # need a blanket 30-minute grace.
+    unless_metric: Optional[str] = None
 
 
 # The default rule pack — the divergence signatures that actually kill RL
@@ -246,14 +265,19 @@ DEFAULT_RULES: Tuple[Dict[str, Any], ...] = (
      "cooldown": 600, "severity": "warn",
      "description": "trained samples lag many weight versions behind: "
                     "the staleness gate is not holding"},
-    # 30 min, not 10: the grace also covers the FIRST optimizer step,
-    # which on TPU sits behind the warmup XLA compile — a cold start
-    # must not burn an evidence bundle and an autoscale inhibit.
+    # Short grace + compile-aware suppression, not a blanket 30 minutes:
+    # the FIRST optimizer step on TPU sits behind the warmup XLA compile,
+    # and the old fix was a fixed 1800s grace that also hid every
+    # genuinely-wedged trainer for half an hour. With the compile
+    # observatory the rule is suppressed only while compile/inflight (or
+    # the worker's names.compile_inflight flag) says a compile is
+    # actually in progress — a cold start stays quiet, a wedged trainer
+    # alerts in minutes.
     {"id": "trainer_stalled", "metric": "train/optimizer_steps",
-     "kind": "absence", "for": 1800, "cooldown": 1800,
-     "severity": "critical",
-     "description": "no optimizer step in 30 minutes: the training "
-                    "pipeline is wedged"},
+     "kind": "absence", "for": 300, "cooldown": 1800,
+     "severity": "critical", "unless": "compile/inflight",
+     "description": "no optimizer step in 5 minutes and no compile in "
+                    "flight: the training pipeline is wedged"},
     {"id": "fleet_down", "metric": "gsmgr/healthy_servers",
      "kind": "threshold", "op": "lt", "value": 1.0, "for": 60,
      "cooldown": 300, "severity": "critical",
@@ -296,6 +320,40 @@ DURABILITY_RULES: Tuple[Dict[str, Any], ...] = (
                     "at-least-once loop is broken somewhere between push, "
                     "train, and ack (perf_probe spool-status; "
                     "docs/operations.md §Did we lose samples?)"},
+)
+
+
+# Armed only when compile_watch.enabled (rules_from_config): the series
+# these watch exist only with the observatory on, and compile_stall is a
+# threshold on a gauge a disabled fleet never exports. Thresholds follow
+# the default-pack philosophy — a healthy warmup fires nothing.
+COMPILE_RULES: Tuple[Dict[str, Any], ...] = (
+    # ~2 storms/100s sustained: one stray shape after warmup is a blip
+    # (logged + counted, no alert); a steady drip means something feeds
+    # the jit unbucketed shapes every step (docs/operations.md §my step
+    # got slow).
+    {"id": "recompile_storm", "metric": "compile/storm_events",
+     "kind": "rate", "op": "gt", "value": 0.02, "for": 10, "window": 120,
+     "cooldown": 600, "severity": "warn",
+     "description": "recompiles of previously-stable jit functions keep "
+                    "arriving after warmup: shape churn is defeating the "
+                    "bucketing (perf_probe compile-status names the fn "
+                    "and offending shape)"},
+    {"id": "hbm_pressure", "metric": "hbm/utilization",
+     "kind": "threshold", "op": "gt", "value": 0.92, "for": 60,
+     "cooldown": 600, "severity": "warn", "agg": "max",
+     "description": "a device sits above 92% HBM for a minute: the next "
+                    "weight publish or shape spike OOMs — check "
+                    "hbm/watermark_bytes for which allocator owns the "
+                    "peak (docs/weight_sync.md §HBM headroom)"},
+    # 20 min inside ONE compile: even pathological warmup compiles
+    # finish in minutes — a compile/inflight gauge stuck >= 1 this long
+    # means the compile itself hung (or the end-hook never ran).
+    {"id": "compile_stall", "metric": "compile/inflight",
+     "kind": "threshold", "op": "ge", "value": 1.0, "for": 1200,
+     "cooldown": 1800, "severity": "critical",
+     "description": "a jit compile has been in flight for 20+ minutes: "
+                    "the run is wedged inside XLA, not between steps"},
 )
 
 
@@ -392,11 +450,27 @@ def parse_rule(raw: Dict[str, Any],
             f"rule {rid!r}: baseline rules need value > 0 "
             f"(the deviation multiplier)"
         )
+    unless = raw.get("unless")
+    if unless is not None:
+        unless = str(unless).strip()
+        if kind != "absence":
+            raise SentinelConfigError(
+                f"rule {rid!r}: 'unless' only applies to absence rules "
+                f"(it suppresses the missing-progress predicate while "
+                f"the named metric is live)"
+            )
+        if unless not in catalog:
+            close = difflib.get_close_matches(unless, sorted(catalog), n=3)
+            hint = f" (did you mean: {', '.join(close)}?)" if close else ""
+            raise SentinelConfigError(
+                f"rule {rid!r}: unknown 'unless' metric {unless!r}{hint}"
+            )
     return Rule(
         id=rid, metric=metric, kind=kind, op=op, value=value,
         for_secs=for_secs, cooldown_secs=cooldown, severity=severity,
         window_secs=window, agg=agg, action=action,
         description=str(raw.get("description", "")),
+        unless_metric=unless,
     )
 
 
@@ -416,16 +490,20 @@ def parse_rules(raw_rules: Sequence[Dict[str, Any]],
     return rules
 
 
-def rules_from_config(cfg, durability_enabled: bool = False) -> List[Rule]:
+def rules_from_config(cfg, durability_enabled: bool = False,
+                      compile_watch_enabled: bool = False) -> List[Rule]:
     """``SentinelConfig`` → parsed rule list: the default pack (unless
     ``default_rules=false``), the durability pack when the durable
-    sample spool is armed, plus the operator's ``rules`` entries. This
+    sample spool is armed, the compile/HBM pack when the compile
+    observatory is armed, plus the operator's ``rules`` entries. This
     is the function ``validate_config`` front-runs at parse time."""
     raw: List[Dict[str, Any]] = []
     if getattr(cfg, "default_rules", True):
         raw.extend(dict(r) for r in DEFAULT_RULES)
         if durability_enabled:
             raw.extend(dict(r) for r in DURABILITY_RULES)
+        if compile_watch_enabled:
+            raw.extend(dict(r) for r in COMPILE_RULES)
     raw.extend(getattr(cfg, "rules", []) or [])
     return parse_rules(raw)
 
@@ -664,6 +742,18 @@ class Sentinel:
                    cur: Optional[float], now: float) -> bool:
         r = st.rule
         if r.kind == "absence":
+            if r.unless_metric is not None:
+                # Compile-aware suppression: a live nonzero reading on
+                # the unless-metric family (any worker, any label) means
+                # the absence is EXPLAINED — the worker is inside a jit
+                # compile, not wedged. Source expiry already dropped
+                # stale readings, so a SIGKILLed worker's last gauge
+                # stops suppressing within source_expiry_secs.
+                u = self._series.get(r.unless_metric)
+                if u is not None and any(
+                    v > 0 for v, _ in u.latest.values()
+                ):
+                    return False
             # Grace from sentinel start: a metric never seen only counts
             # as absent once the run is older than the rule's window.
             last = s.last_seen if (s and s.last_seen is not None) \
@@ -717,10 +807,51 @@ class Sentinel:
             return True
         return False
 
+    # ---- compile-aware suppression (base/compile_watch.py) ----
+
+    def _compile_inflight_fresh(self, max_age_secs: float = 60.0) -> bool:
+        """Fresh name-resolve read of every worker's
+        ``names.compile_inflight`` flag (called only at an actual fire
+        attempt of an unless-guarded absence rule, never under the
+        engine lock — same discipline as :meth:`_silenced`). The metric
+        path above already suppresses in-memory; this catches the gap
+        where a worker is wedged INSIDE a compile and its telemetry
+        flush (but not its heartbeat thread) stopped. Flags are
+        rewritten every heartbeat, so anything older than
+        ``max_age_secs`` is a dead worker's ghost and does not
+        suppress."""
+        try:
+            vals = name_resolve.get_subtree(
+                names.compile_inflight_root(self.experiment, self.trial))
+        except Exception:  # noqa: BLE001 — no flags registered
+            return False
+        now = self.wall()
+        for raw in vals:
+            try:
+                ts = float(json.loads(raw).get("ts", 0.0))
+            except Exception:  # noqa: BLE001 — torn write
+                continue
+            if now - ts < max_age_secs:
+                return True
+        return False
+
     # ---- firing side effects ----
 
     def _on_fire(self, st: _RuleState, rec: Dict) -> None:
         r = st.rule
+        if r.kind == "absence" and r.unless_metric is not None \
+                and self._compile_inflight_fresh():
+            # Roll back to pending exactly like a silence: the compile
+            # drains, the flag disappears, and the next tick re-attempts
+            # with the `for:` hold still satisfied.
+            with self._lock:
+                if st.state == "firing":
+                    st.state = "pending"
+                st.last_fired = None
+                st.fire_count -= 1
+            self.registry.inc(
+                f"sentinel/compile_suppressed{{rule={r.id}}}")
+            return
         if self._silenced(r):
             # Operator silence: roll the transition back to pending (the
             # `for:` hold stays satisfied; the next tick re-attempts) and

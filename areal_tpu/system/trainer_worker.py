@@ -39,14 +39,17 @@ from areal_tpu.api.model import (
     make_interface,
 )
 from areal_tpu.api.train_config import (
+    CompileWatchConfig,
     DurabilityConfig,
     GoodputConfig,
     RewardServiceConfig,
     TelemetryConfig,
     WeightSyncConfig,
 )
-from areal_tpu.base import logging, name_resolve, names, telemetry
+from areal_tpu.base import compile_watch, logging, name_resolve, names, \
+    telemetry
 from areal_tpu.system import goodput as goodput_mod
+from areal_tpu.system import memwatch
 from areal_tpu.system.sample_spool import (
     SPOOL_KEY,
     SpoolIngest,
@@ -128,6 +131,15 @@ class TrainerWorkerConfig:
     # still settles instead of resending forever.
     durability: DurabilityConfig = dataclasses.field(
         default_factory=DurabilityConfig
+    )
+    # Compile & HBM observatory (base/compile_watch.py +
+    # system/memwatch.py): jit compile-event tracing over the train
+    # engine's entry points, HBM gauges/watermarks around the big
+    # allocators, and the compile-inflight heartbeat flag the sentinel's
+    # trainer_stalled rule reads. Off by default — zero wrappers, zero
+    # device polls, scrape bit-identical.
+    compile_watch: CompileWatchConfig = dataclasses.field(
+        default_factory=CompileWatchConfig
     )
     # Multi-host SPMD (reference global_comm.py:48): dist_world processes —
     # one per host — join one jax.distributed program; rank 0 owns every
@@ -300,6 +312,13 @@ class TrainerWorker:
                     mfu_name="train/mfu", context="trainer",
                 )
                 self._flops = monitor.FlopsCounter()
+            # Compile & HBM observatory: the module-global facades the
+            # train engine's jit sites (backend/jax_train.py) and the
+            # weight-publish paths below call through. Disabled config
+            # keeps the NULL objects — the wrap/watermark calls resolve
+            # to the raw fn / a no-op context.
+            compile_watch.configure(cfg.compile_watch, telemetry.get())
+            memwatch.configure(cfg.compile_watch, telemetry.get())
         logger.info(
             f"trainer up (rank {cfg.dist_rank}/{cfg.dist_world}): "
             f"models={list(self.models)} mfcs={list(self.interfaces)}"
@@ -724,7 +743,8 @@ class TrainerWorker:
         t0 = time.monotonic()
         with telemetry.span("trainer/weight_publish", role=role,
                             version=version, transport="disk"), \
-                self._ledger.state("comm"):
+                self._ledger.state("comm"), \
+                memwatch.watermark("trainer/weight_publish"):
             self._save_role(role, path, fmt="native")
         save_secs = time.monotonic() - t0
         telemetry.set_gauge("trainer/weight_publish_secs", save_secs)
@@ -771,7 +791,8 @@ class TrainerWorker:
         # wire leg of tensors already gathered (and the servers' uploads).
         with telemetry.span("trainer/weight_publish", role=role,
                             version=version, transport="stream"), \
-                self._ledger.state("comm"):
+                self._ledger.state("comm"), \
+                memwatch.watermark("trainer/weight_publish"):
             pub.publish(sorted(flatten_pytree(params).items()), version)
         publish_secs = time.monotonic() - t0
         telemetry.set_gauge("trainer/weight_publish_secs", publish_secs)
@@ -800,7 +821,8 @@ class TrainerWorker:
         target = self._device_publish_shardings(role, params)
         with telemetry.span("trainer/weight_publish", role=role,
                             version=version, transport="device"), \
-                self._ledger.state("comm"):
+                self._ledger.state("comm"), \
+                memwatch.watermark("trainer/weight_publish"):
             pub = rsh.publish_device(
                 self.cfg.experiment, self.cfg.trial, role, params,
                 target_shardings=target, version=version,
@@ -1085,8 +1107,14 @@ class TrainerWorker:
             # Lifecycle FSM endpoint (reference worker_base.py:474); only
             # rank 0 serves it — pausing rank 0 stalls the whole SPMD group
             # at the next broadcast, which is exactly pause semantics.
+            # Compile-aware liveness: the heartbeat thread publishes
+            # names.compile_inflight while a jit compile is in progress
+            # so the sentinel's trainer_stalled rule can tell a warmup
+            # compile from a wedge (the NULL watch's inflight() is a
+            # constant False — zero traffic when disabled).
             ctrl = WorkerControl(
-                self.cfg.experiment, self.cfg.trial, self.cfg.handler
+                self.cfg.experiment, self.cfg.trial, self.cfg.handler,
+                inflight_fn=compile_watch.inflight,
             )
             # Liveness: the control heartbeat also keeps the trainer's
             # stream advertisements leased (request ROUTER + trajectory
@@ -1109,6 +1137,9 @@ class TrainerWorker:
                 # Accrue the in-progress state (idle between requests)
                 # so the scrape moves even when no handler runs.
                 self._ledger.poll()
+                # HBM gauges piggyback on the serve cadence (rate-limited
+                # inside the watch; the NULL watch is a no-op).
+                memwatch.sample()
                 telemetry.set_gauge("trainer/store_size", len(self.store))
             ctrl.close()
         else:
@@ -1128,4 +1159,6 @@ class TrainerWorker:
         for pub in self._weight_publishers.values():
             pub.close()
         self._ledger.flush()
+        memwatch.shutdown()
+        compile_watch.shutdown()
         telemetry.shutdown()  # final flush to the aggregator

@@ -196,25 +196,15 @@ class WeightStreamPublisher:
         return pub.manifest()
 
     def _gather_loop(self, pub: _PublishedVersion) -> None:
+        from areal_tpu.system import memwatch
+
         t0 = time.monotonic()
         try:
-            for i, leaf in enumerate(pub.leaves):
-                a = _as_wire_array(leaf)
-                if a.nbytes != pub.nbytes[i]:
-                    raise WeightStreamError(
-                        f"tensor {pub.names[i]} gathered {a.nbytes} bytes, "
-                        f"manifest promised {pub.nbytes[i]}"
-                    )
-                pub.arrays[i] = a
-                pub.leaves[i] = None  # drop the device ref
-                raw = a.reshape(-1).view(np.uint8) if a.nbytes else \
-                    np.empty(0, np.uint8)
-                cb = pub.chunk_bytes
-                pub.crcs[i] = [
-                    zlib.crc32(memoryview(raw)[c * cb:(c + 1) * cb])
-                    for c in range(pub.n_chunks[i])
-                ]
-                pub.ready[i].set()
+            # The d2h gather holds the compute-dtype publish copy on
+            # device until each leaf's ref drops below — the trainer-side
+            # HBM high-water mark of a streamed publish.
+            with memwatch.watermark("weight_stream/gather"):
+                self._gather_leaves(pub)
             pub.gather_secs = time.monotonic() - t0
             pub.complete.set()
             # d2h leg throughput for the unified telemetry stream (the
@@ -235,6 +225,25 @@ class WeightStreamPublisher:
             for ev in pub.ready:
                 ev.set()
             pub.complete.set()
+
+    def _gather_leaves(self, pub: _PublishedVersion) -> None:
+        for i, leaf in enumerate(pub.leaves):
+            a = _as_wire_array(leaf)
+            if a.nbytes != pub.nbytes[i]:
+                raise WeightStreamError(
+                    f"tensor {pub.names[i]} gathered {a.nbytes} bytes, "
+                    f"manifest promised {pub.nbytes[i]}"
+                )
+            pub.arrays[i] = a
+            pub.leaves[i] = None  # drop the device ref
+            raw = a.reshape(-1).view(np.uint8) if a.nbytes else \
+                np.empty(0, np.uint8)
+            cb = pub.chunk_bytes
+            pub.crcs[i] = [
+                zlib.crc32(memoryview(raw)[c * cb:(c + 1) * cb])
+                for c in range(pub.n_chunks[i])
+            ]
+            pub.ready[i].set()
 
     def wait_complete(self, version: int, timeout: float = 300.0) -> bool:
         with self._lock:

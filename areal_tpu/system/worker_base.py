@@ -125,7 +125,8 @@ class HeartbeatThread:
 
     def __init__(self, experiment: str, trial: str, worker_name: str,
                  keys: Iterable[str] = (), interval: float = 2.0,
-                 incarnation: Optional[int] = None):
+                 incarnation: Optional[int] = None,
+                 inflight_fn: Optional[Callable[[], bool]] = None):
         self.worker_name = worker_name
         self.incarnation = (
             incarnation if incarnation is not None else env_incarnation()
@@ -139,6 +140,18 @@ class HeartbeatThread:
         self._interval = max(float(interval), 0.05)
         self._lock = threading.Lock()
         self._hb_key = names.worker_heartbeat(experiment, trial, worker_name)
+        # Compile-aware liveness (base/compile_watch.py): while
+        # ``inflight_fn`` reports a jit compile in progress, publish
+        # names.compile_inflight with a fresh ts every beat so the
+        # sentinel can tell "compiling" from "wedged"; delete it the
+        # beat the compile drains. Zero name-resolve traffic when the
+        # worker never compiles (or the observatory is disabled —
+        # inflight_fn is then compile_watch.NULL.inflight ≡ False).
+        self._inflight_fn = inflight_fn
+        self._inflight_key = names.compile_inflight(
+            experiment, trial, worker_name
+        )
+        self._inflight_written = False
         self._stop = threading.Event()
         self._beat()  # visible before the first interval elapses
         self._thread = threading.Thread(
@@ -187,6 +200,24 @@ class HeartbeatThread:
             )
         except Exception:  # noqa: BLE001
             pass
+        if self._inflight_fn is None:
+            return
+        try:
+            if self._inflight_fn():
+                # Rewritten every beat: observers judge freshness by ts,
+                # so a SIGKILLed worker's stale flag stops suppressing
+                # alerts within ~a minute instead of forever.
+                name_resolve.add(
+                    self._inflight_key,
+                    json.dumps({"ts": time.time()}),
+                    replace=True, delete_on_exit=False,
+                )
+                self._inflight_written = True
+            elif self._inflight_written:
+                self._inflight_written = False
+                name_resolve.delete(self._inflight_key)
+        except Exception:  # noqa: BLE001 — a heartbeat must never
+            pass  # kill a worker
 
     def _loop(self) -> None:
         while not self._stop.wait(self._interval):
@@ -202,6 +233,12 @@ class HeartbeatThread:
             name_resolve.delete(self._hb_key)
         except Exception:  # noqa: BLE001 — already gone / repo reset
             pass
+        if self._inflight_written:
+            self._inflight_written = False
+            try:
+                name_resolve.delete(self._inflight_key)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class WorkerControl:
@@ -209,7 +246,8 @@ class WorkerControl:
 
     def __init__(self, experiment: str, trial: str, worker_name: str,
                  keepalive_ttl: Optional[float] = None,
-                 heartbeat_interval: Optional[float] = None):
+                 heartbeat_interval: Optional[float] = None,
+                 inflight_fn: Optional[Callable[[], bool]] = None):
         self.worker_name = worker_name
         self.state = WorkerState.CREATED
         self.incarnation = env_incarnation()
@@ -235,6 +273,7 @@ class WorkerControl:
                 interval=(heartbeat_interval or env_heartbeat_interval()
                           or keepalive_ttl / 3.0),
                 incarnation=self.incarnation,
+                inflight_fn=inflight_fn,
             )
             self._hb.lease(self._key, addr, keepalive_ttl)
         self._reconfigure_cb: Optional[Callable[[Any], Any]] = None

@@ -138,8 +138,14 @@ def main():
     pack_fill = mbu.pack_fill(pack_mbs)
     del pack_mbs
 
+    # Warmup/compile wall clock as a first-class bench field: the trace
+    # cost every fresh launch pays before step 1. Cache-sensitive — a warm
+    # persistent cache (apps/launcher.py) collapses it — so the
+    # bench_compare gate carries a wide tolerance (docs/benchmarks.md).
+    t0 = time.perf_counter()
     iface.train_step(model, batch, spec)  # warmup/compile
     jax.block_until_ready(model.module.params)
+    warmup_compile_s = time.perf_counter() - t0
     telemetry.get().snapshot(reset=True)  # drop warmup-step spans
     t0 = time.perf_counter()
     steps = 3
@@ -164,6 +170,22 @@ def main():
 
     n_chips = jax.device_count()
     tokens_per_sec_chip = steps * total / dt / n_chips
+
+    # Device-memory high-water mark over the timed PPO steps (the whole
+    # process so far, which the train loop dominates) — the same
+    # allocator counter system/memwatch.py exports live as hbm/peak_bytes.
+    # CPU backends have no memory_stats(); the field is then omitted and
+    # bench_compare reports it n/a (docs/benchmarks.md).
+    hbm_peak_gb = None
+    try:
+        peaks = [
+            (d.memory_stats() or {}).get("peak_bytes_in_use", 0)
+            for d in jax.local_devices()
+        ]
+        if any(peaks):
+            hbm_peak_gb = max(peaks) / float(1 << 30)
+    except Exception:  # noqa: BLE001 — backend-dependent, best-effort
+        pass
 
     # North-star metric #2 (BASELINE.json): trainer→rollout weight-sync
     # latency, measured through the STREAMED transport (the production
@@ -398,6 +420,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
         "pack_fill": round(pack_fill, 4),
+        "warmup_compile_s": round(warmup_compile_s, 3),
         "weight_sync_latency_s": round(weight_sync_s, 3),
         "weight_sync_io_s": round(weight_sync_io_s, 3),
         "weight_sync_transport_s": round(weight_sync_transport_s, 3),
@@ -427,6 +450,8 @@ def main():
         # transport. See docs/benchmarks.md for the discontinuity note.
         "weight_sync_transport_method": "streamed+device-measured",
     }
+    if hbm_peak_gb is not None:
+        out["hbm_peak_gb"] = round(hbm_peak_gb, 3)
     if train_phases is not None:
         # Phase fields are a measurement-method ADDITION (AREAL_TELEMETRY=1
         # runs only): phases sum to ~the per-step wall clock; the headline

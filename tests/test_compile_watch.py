@@ -1,0 +1,503 @@
+"""Compile & HBM observatory (base/compile_watch.py, system/memwatch.py,
+docs/observability.md §Compile & memory).
+
+Fake clocks + fake devices everywhere: compile timing is driven by an
+injected monotonic clock the wrapped fn advances, HBM readings come from
+injectable device fakes with scripted ``memory_stats()`` dicts — zero
+real sleeps, no jax arrays, no backend dependence. The disabled contract
+(scrape bit-identical with the observatory off) is pinned here, and the
+sentinel's compile/HBM rule pack is validated through the same
+``rules_from_config`` path the master runs.
+"""
+
+import json
+
+import pytest
+
+from areal_tpu.api.train_config import CompileWatchConfig, SentinelConfig
+from areal_tpu.base import compile_watch as cw
+from areal_tpu.base import telemetry
+from areal_tpu.system import memwatch as mw
+from areal_tpu.system.sentinel import (
+    COMPILE_RULES,
+    DEFAULT_RULES,
+    SentinelConfigError,
+    parse_rules,
+    rules_from_config,
+)
+
+pytestmark = pytest.mark.compilewatch
+
+
+class Arr:
+    """Array-like stand-in: compile_watch only reads .shape/.dtype."""
+
+    def __init__(self, shape, dtype="float32"):
+        self.shape = shape
+        self.dtype = dtype
+
+
+class FakeDevice:
+    """jax device stand-in: memory_stats() returns a mutable dict."""
+
+    def __init__(self, stats):
+        self.stats = stats
+
+    def memory_stats(self):
+        return self.stats
+
+
+def make_watch(**kw):
+    """(watch, registry, clock dict) with a controllable monotonic."""
+    reg = telemetry.TelemetryRegistry()
+    t = {"now": 0.0}
+    watch = cw.CompileWatch(reg, clock=lambda: t["now"], **kw)
+    return watch, reg, t
+
+
+# ---------------------------------------------------------------------------
+# abstract signatures
+# ---------------------------------------------------------------------------
+
+
+def test_abstract_signature_keys_on_shape_dtype_and_statics():
+    sig = lambda *a, **k: cw.abstract_signature(a, k)  # noqa: E731
+    assert sig(Arr((4, 8))) == sig(Arr((4, 8)))
+    assert sig(Arr((4, 8))) != sig(Arr((4, 9)))
+    assert sig(Arr((4, 8))) != sig(Arr((4, 8), dtype="bfloat16"))
+    # static arg VALUES key the jit cache, so they key the signature too
+    assert sig(Arr((4, 8)), 128) != sig(Arr((4, 8)), 256)
+    assert sig(x=1, y=2) == sig(y=2, x=1)  # kwargs order-insensitive
+    # containers recurse; list vs tuple is a retrace in jax too
+    assert sig([Arr((2,))]) != sig((Arr((2,)),))
+
+
+# ---------------------------------------------------------------------------
+# compile-event recording
+# ---------------------------------------------------------------------------
+
+
+def test_compile_events_recorded_with_fake_clock():
+    watch, reg, t = make_watch()
+    inflight_seen = []
+
+    def fn(x):
+        inflight_seen.append(watch.inflight())
+        t["now"] += 2.5  # the fake "compile + first dispatch" wall time
+        return x
+
+    f = watch.wrap("train/grad", fn)
+    f(Arr((4, 128)))
+    snap = reg.snapshot(reset=False)
+    assert snap["counters"]["compile/events{fn=train/grad}"] == 1.0
+    assert snap["counters"]["compile/secs{fn=train/grad}"] == 2.5
+    assert snap["gauges"]["compile/distinct_shapes{fn=train/grad}"] == 1.0
+    # the gauge pulsed up during the call and is back to 0 after
+    assert inflight_seen == [True]
+    assert snap["gauges"]["compile/inflight"] == 0.0
+    assert not watch.inflight()
+    # a known signature is a cache hit: no new compile event
+    f(Arr((4, 128)))
+    snap = reg.snapshot(reset=False)
+    assert snap["counters"]["compile/events{fn=train/grad}"] == 1.0
+    # a new shape compiles again and bumps distinct_shapes
+    f(Arr((4, 256)))
+    snap = reg.snapshot(reset=False)
+    assert snap["counters"]["compile/events{fn=train/grad}"] == 2.0
+    assert snap["counters"]["compile/secs{fn=train/grad}"] == 5.0
+    assert snap["gauges"]["compile/distinct_shapes{fn=train/grad}"] == 2.0
+    assert watch.stats()["train/grad"] == {
+        "calls": 3.0, "distinct_shapes": 2.0,
+    }
+
+
+def test_wrapper_passes_through_result_and_exceptions():
+    watch, reg, t = make_watch()
+    f = watch.wrap("train/apply", lambda x, s: (x, s))
+    a = Arr((2, 2))
+    assert f(a, s=7) == (a, 7)
+    assert f.__wrapped__(a, s=7) == (a, 7)
+
+    def boom(x):
+        raise RuntimeError("compile blew up")
+
+    g = watch.wrap("train/boom", boom)
+    with pytest.raises(RuntimeError, match="blew up"):
+        g(Arr((1,)))
+    # the inflight gauge must unwind even on an exception mid-compile
+    assert not watch.inflight()
+    assert reg.snapshot(reset=False)["gauges"]["compile/inflight"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# recompile-storm detection
+# ---------------------------------------------------------------------------
+
+
+def test_storm_fires_only_after_shape_stability(monkeypatch):
+    warned = []
+    monkeypatch.setattr(cw.logger, "warning", warned.append)
+    watch, reg, t = make_watch(storm_warmup_calls=4)
+    f = watch.wrap("gen/prefill", lambda x: x)
+    stable = Arr((8, 512))
+    f(stable)  # cold-start compile: never a storm
+    counters = reg.snapshot(reset=False)["counters"]
+    assert "compile/storm_events" not in counters
+    # a second new shape BEFORE warmup stability: still churn, not storm
+    f(Arr((8, 640)))
+    assert "compile/storm_events" not in \
+        reg.snapshot(reset=False)["counters"]
+    # now hold shape-stable through the warmup window...
+    for _ in range(4):
+        f(stable)
+    # ...then a new shape is exactly the storm signature
+    f(Arr((8, 768)))
+    counters = reg.snapshot(reset=False)["counters"]
+    assert counters["compile/storm_events"] == 1.0
+    assert len(warned) == 1 and "recompile storm" in warned[0]
+    # the next new shape arrives with calls_since_new_sig reset: no storm
+    f(Arr((8, 896)))
+    assert reg.snapshot(reset=False)["counters"][
+        "compile/storm_events"] == 1.0
+    # stability then another new shape storms again (counted, warned once
+    # per offending signature)
+    for _ in range(4):
+        f(stable)
+    f(Arr((8, 1024)))
+    assert reg.snapshot(reset=False)["counters"][
+        "compile/storm_events"] == 2.0
+
+
+def test_fresh_wrappers_recompiling_known_shapes_are_not_storms():
+    """The reshard identity pattern: a NEW jit object per publish group
+    recompiles shapes the per-name ledger already saw. That is a real
+    compile (events count) but not shape churn (no storm)."""
+    watch, reg, t = make_watch(storm_warmup_calls=2)
+    shape = Arr((16, 1024))
+    for i in range(6):
+        f = watch.wrap("reshard/identity", lambda x: x)
+        f(shape)
+        f(shape)  # warm call on the same wrapper
+    snap = reg.snapshot(reset=False)
+    # every fresh wrapper's first call recorded as a compile event...
+    assert snap["counters"]["compile/events{fn=reshard/identity}"] == 6.0
+    # ...but the name-level shape set never grew past 1, and no storm
+    assert snap["gauges"][
+        "compile/distinct_shapes{fn=reshard/identity}"] == 1.0
+    assert "compile/storm_events" not in snap["counters"]
+
+
+# ---------------------------------------------------------------------------
+# persistent-cache accounting
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_miss_probing(tmp_path):
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+    watch, reg, t = make_watch(cache_dir=str(cache))
+
+    def cold(x):
+        # XLA really compiled: it wrote a new persistent-cache entry
+        n = len(list(cache.iterdir()))
+        (cache / f"entry-{n}").write_text("xla")
+        return x
+
+    f = watch.wrap("train/grad", cold)
+    f(Arr((4, 128)))
+    counters = reg.snapshot(reset=False)["counters"]
+    assert counters["compile/cache_misses"] == 1.0
+    assert "compile/cache_hits" not in counters
+    # a compile that produces no new entry was served from the cache
+    g = watch.wrap("train/grad", lambda x: x)
+    g(Arr((4, 128)))
+    counters = reg.snapshot(reset=False)["counters"]
+    assert counters["compile/cache_misses"] == 1.0
+    assert counters["compile/cache_hits"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# MemWatch: HBM gauges, watermarks, degradation
+# ---------------------------------------------------------------------------
+
+
+def make_memwatch(devices, **kw):
+    reg = telemetry.TelemetryRegistry()
+    t = {"now": 0.0}
+    m = mw.MemWatch(reg, devices_fn=lambda: devices,
+                    clock=lambda: t["now"], **kw)
+    return m, reg, t
+
+
+def test_memwatch_exports_per_device_gauges_rate_limited():
+    d0 = FakeDevice({"bytes_in_use": 100.0, "peak_bytes_in_use": 150.0,
+                     "bytes_limit": 1000.0})
+    d1 = FakeDevice({"bytes_in_use": 300.0, "peak_bytes_in_use": 300.0,
+                     "bytes_limit": 1000.0})
+    m, reg, t = make_memwatch([d0, d1], sample_interval_secs=10.0)
+    assert m.sample() == 300.0
+    gauges = reg.snapshot(reset=False)["gauges"]
+    assert gauges["hbm/bytes_in_use{device=0}"] == 100.0
+    assert gauges["hbm/peak_bytes{device=0}"] == 150.0
+    assert gauges["hbm/limit_bytes{device=1}"] == 1000.0
+    assert gauges["hbm/bytes_in_use{device=1}"] == 300.0
+    # inside the interval: rate-limited (None), gauges untouched
+    d0.stats["bytes_in_use"] = 900.0
+    t["now"] = 5.0
+    assert m.sample() is None
+    assert reg.snapshot(reset=False)["gauges"][
+        "hbm/bytes_in_use{device=0}"] == 100.0
+    # force bypasses the limiter; peak_gb tracks the high-water mark
+    assert m.sample(force=True) == 900.0
+    assert m.peak_gb() == 900.0 / (1 << 30)
+    # past the interval the limiter opens again
+    t["now"] = 16.0
+    assert m.sample() == 900.0
+
+
+def test_memwatch_watermark_sites_are_monotonic_maxima():
+    dev = FakeDevice({"bytes_in_use": 100.0, "peak_bytes_in_use": 100.0,
+                      "bytes_limit": 1000.0})
+    m, reg, t = make_memwatch([dev])
+    with m.watermark("weight_stream/gather"):
+        dev.stats["bytes_in_use"] = 800.0
+    gauges = reg.snapshot(reset=False)["gauges"]
+    assert gauges["hbm/watermark_bytes{site=weight_stream/gather}"] == 800.0
+    # a later, smaller peak must not lower the recorded high-water mark
+    dev.stats["bytes_in_use"] = 200.0
+    with m.watermark("weight_stream/gather"):
+        pass
+    assert reg.snapshot(reset=False)["gauges"][
+        "hbm/watermark_bytes{site=weight_stream/gather}"] == 800.0
+    assert m.site_peaks() == {"weight_stream/gather": 800.0}
+
+
+def test_memwatch_degrades_once_without_memory_stats(monkeypatch):
+    """CPU-backend contract: one warning + one counter bump, then quiet —
+    never fake zero gauges that read as an empty chip."""
+    warned = []
+    monkeypatch.setattr(mw.logger, "warning", warned.append)
+
+    class CpuDevice:  # no memory_stats attribute at all
+        pass
+
+    m, reg, t = make_memwatch([CpuDevice()])
+    assert m.sample(force=True) is None
+    assert m.sample(force=True) is None
+    assert m.sample(force=True) is None
+    snap = reg.snapshot(reset=False)
+    assert snap["counters"]["hbm/memory_stats_unavailable"] == 1.0
+    assert not any(k.startswith("hbm/bytes") for k in snap["gauges"])
+    assert len(warned) == 1 and "degraded" in warned[0]
+    # degraded watermarks are cheap no-ops, not errors
+    with m.watermark("train/fwd_bwd"):
+        pass
+    assert m.site_peaks() == {}
+    assert m.peak_gb() == 0.0
+
+
+def test_memwatch_skips_devices_that_return_no_stats():
+    """Mixed fleets: a device returning None/{} (some runtime versions)
+    is skipped while real readings still export."""
+
+    class NoneDevice:
+        def memory_stats(self):
+            return None
+
+    dev = FakeDevice({"bytes_in_use": 42.0, "bytes_limit": 100.0})
+    m, reg, t = make_memwatch([NoneDevice(), dev])
+    assert m.sample(force=True) == 42.0
+    gauges = reg.snapshot(reset=False)["gauges"]
+    # the real device is index 0 of the READINGS, not of the device list
+    assert gauges["hbm/bytes_in_use{device=0}"] == 42.0
+
+
+# ---------------------------------------------------------------------------
+# disabled contract: bit-identical scrape
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_keeps_null_sinks_and_scrape_bit_identical():
+    reg = telemetry.TelemetryRegistry()
+    reg.inc("train/optimizer_steps")
+    reg.set_gauge("train/mfu", 0.41)
+    before = telemetry.render_prometheus(reg.snapshot(reset=False))
+
+    assert cw.configure(CompileWatchConfig(enabled=False), reg) is cw.NULL
+    assert mw.configure(CompileWatchConfig(enabled=False), reg) is mw.NULL
+    try:
+        assert not cw.enabled() and not mw.enabled()
+
+        def fn(x):
+            return x
+
+        # the raw function object comes back — zero per-call overhead
+        assert cw.watched_jit("train/grad", fn) is fn
+        assert not cw.inflight()
+        assert mw.sample(force=True) is None
+        with mw.watermark("train/fwd_bwd"):
+            pass
+        assert mw.peak_gb() == 0.0
+    finally:
+        cw.shutdown()
+        mw.shutdown()
+    after = telemetry.render_prometheus(reg.snapshot(reset=False))
+    assert after == before
+    assert "compile" not in after and "hbm" not in after
+
+
+def test_configure_enabled_installs_and_shutdown_restores_null():
+    reg = telemetry.TelemetryRegistry()
+    try:
+        watch = cw.configure(
+            CompileWatchConfig(enabled=True, storm_warmup_calls=3),
+            reg, cache_dir=None,
+        )
+        assert watch is cw.get() and cw.enabled()
+        assert watch.storm_warmup_calls == 3
+        m = mw.configure(
+            CompileWatchConfig(enabled=True, mem_sample_interval_secs=2.0),
+            reg, devices_fn=lambda: [],
+        )
+        assert m is mw.get() and mw.enabled()
+        assert m.sample_interval_secs == 2.0
+        wrapped = cw.watched_jit("train/grad", lambda x: x)
+        assert wrapped.__wrapped__ is not None
+        wrapped(Arr((2, 2)))
+        assert reg.snapshot(reset=False)["counters"][
+            "compile/events{fn=train/grad}"] == 1.0
+    finally:
+        cw.shutdown()
+        mw.shutdown()
+    assert cw.get() is cw.NULL and mw.get() is mw.NULL
+
+
+# ---------------------------------------------------------------------------
+# aggregator: derived utilization + fleet rollups
+# ---------------------------------------------------------------------------
+
+
+def test_derive_hbm_utilization_injects_ratio_per_device():
+    payload = {"gauges": {
+        "hbm/bytes_in_use{device=0}": 750.0,
+        "hbm/limit_bytes{device=0}": 1000.0,
+        "hbm/bytes_in_use{device=1}": 100.0,
+        "hbm/limit_bytes{device=1}": 400.0,
+        "train/mfu": 0.4,
+    }, "counters": {}}
+    telemetry.TelemetryAggregator._derive_hbm_utilization(payload)
+    assert payload["gauges"]["hbm/utilization{device=0}"] == 0.75
+    assert payload["gauges"]["hbm/utilization{device=1}"] == 0.25
+
+
+def test_derive_hbm_utilization_no_hbm_gauges_no_mutation():
+    """The merged-scrape bit-identity hinges on the derivation being a
+    strict no-op when the observatory exports nothing."""
+    payload = {"gauges": {"train/mfu": 0.4, "master/step_secs": 3.0},
+               "counters": {"train/optimizer_steps": 12.0}}
+    before = json.dumps(payload, sort_keys=True)
+    telemetry.TelemetryAggregator._derive_hbm_utilization(payload)
+    assert json.dumps(payload, sort_keys=True) == before
+    # bytes_in_use without a limit (device never reported one): no ratio
+    payload = {"gauges": {"hbm/bytes_in_use{device=0}": 750.0}}
+    telemetry.TelemetryAggregator._derive_hbm_utilization(payload)
+    assert "hbm/utilization{device=0}" not in payload["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# sentinel: the compile/HBM rule pack
+# ---------------------------------------------------------------------------
+
+
+def test_compile_rule_pack_armed_only_with_the_observatory():
+    base = {r.id for r in rules_from_config(SentinelConfig(enabled=True))}
+    armed = {r.id for r in rules_from_config(
+        SentinelConfig(enabled=True), compile_watch_enabled=True)}
+    pack = {r["id"] for r in COMPILE_RULES}
+    assert pack == {"recompile_storm", "hbm_pressure", "compile_stall"}
+    assert pack & base == set()
+    assert pack <= armed
+    assert armed - pack == base == {r["id"] for r in DEFAULT_RULES}
+    # the pack parses clean: severities, metrics, durations all validated
+    by_id = {r.id: r for r in rules_from_config(
+        SentinelConfig(enabled=True), compile_watch_enabled=True)}
+    assert by_id["recompile_storm"].kind == "rate"
+    assert by_id["hbm_pressure"].metric == "hbm/utilization"
+    assert by_id["compile_stall"].severity == "critical"
+
+
+def test_trainer_stalled_carries_compile_unless_guard():
+    rules = {r.id: r for r in
+             rules_from_config(SentinelConfig(enabled=True))}
+    stalled = rules["trainer_stalled"]
+    assert stalled.unless_metric == "compile/inflight"
+    # the drive-by: a wedged trainer alerts in minutes, not after the old
+    # blanket 30-minute grace
+    assert stalled.for_secs == 300.0
+
+
+def test_unless_grammar_is_validated():
+    absence = {"id": "r", "metric": "train/optimizer_steps",
+               "kind": "absence", "for": 60, "cooldown": 60}
+    # valid: absence rule + catalog metric
+    [r] = parse_rules([dict(absence, unless="compile/inflight")])
+    assert r.unless_metric == "compile/inflight"
+    # unless on a non-absence rule is a config error
+    with pytest.raises(SentinelConfigError, match="absence"):
+        parse_rules([{"id": "r", "metric": "train/approx_kl",
+                      "kind": "threshold", "op": "gt", "value": 1.0,
+                      "unless": "compile/inflight"}])
+    # unknown unless metric is caught with a did-you-mean hint
+    with pytest.raises(SentinelConfigError, match="unless"):
+        parse_rules([dict(absence, unless="compile/inflite")])
+
+
+# ---------------------------------------------------------------------------
+# config validation (api/cli_args.py)
+# ---------------------------------------------------------------------------
+
+
+def test_validate_config_gates_the_observatory():
+    from areal_tpu.api import cli_args
+    from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+
+    cfg = PPOMATHConfig()
+    cfg.compile_watch.enabled = True
+    with pytest.raises(cli_args.ConfigError, match="telemetry"):
+        cli_args.validate_config(cfg)
+    cfg.telemetry.enabled = True
+    cli_args.validate_config(cfg)
+    cfg.compile_watch.storm_warmup_calls = 0
+    with pytest.raises(cli_args.ConfigError, match="storm_warmup"):
+        cli_args.validate_config(cfg)
+    cfg.compile_watch.storm_warmup_calls = 16
+    cfg.compile_watch.mem_sample_interval_secs = -1.0
+    with pytest.raises(cli_args.ConfigError, match="mem_sample"):
+        cli_args.validate_config(cfg)
+
+
+def test_validate_config_cross_checks_shape_budgets(monkeypatch):
+    """Unified compiled-shape accounting: serving.max_compiled_shapes
+    must cover the trainer fill sweep's worst-case candidate count too,
+    not only the serving policy's own decode/prefill grids."""
+    from areal_tpu.api import cli_args
+    from areal_tpu.backend import microbatch
+    from areal_tpu.experiments.ppo_math_exp import PPOMATHConfig
+
+    cands = microbatch.worst_case_row_candidates()
+    assert cands >= 1
+    cfg = PPOMATHConfig()
+    cfg.telemetry.enabled = True
+    cfg.compile_watch.enabled = True
+    cfg.serving.enabled = True
+    # generous enough for the serving policy's own worst case AND the
+    # trainer sweep: everything validates
+    cfg.serving.max_compiled_shapes = 4096
+    cli_args.validate_config(cfg)
+    # a trainer sweep that outgrows the serving budget is caught at
+    # parse time with the sweep's own number in the message
+    monkeypatch.setattr(microbatch, "worst_case_row_candidates",
+                        lambda: 5000)
+    with pytest.raises(cli_args.ConfigError,
+                       match="worst-case candidate count"):
+        cli_args.validate_config(cfg)

@@ -763,3 +763,102 @@ def test_trainer_exports_train_gauges(tmp_name_resolve):
             "count"] == 1
     finally:
         telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# compile-aware liveness (base/compile_watch.py, ISSUE 20 drive-by)
+# ---------------------------------------------------------------------------
+
+
+def _stalled_rules():
+    raw = next(r for r in DEFAULT_RULES if r["id"] == "trainer_stalled")
+    return parse_rules([dict(raw)])
+
+
+def test_trainer_stalled_fires_in_minutes_not_half_an_hour(tmp_path):
+    """The drive-by regression: the old fix was a blanket 1800s grace
+    that hid every genuinely-wedged trainer for half an hour. With the
+    compile observatory the grace is 300s + compile-aware suppression —
+    a wedged, NON-compiling trainer alerts in minutes."""
+    s, at, cap = make_sentinel(tmp_path, _stalled_rules())
+    at(0.0)
+    s.feed("trainer", {}, {"train/optimizer_steps": 5.0}, now=0.0)
+    s.tick(0.0)
+    # wedged from t=0 on; well before the 300s window: quiet
+    at(200.0)
+    s.feed("trainer", {}, {"train/optimizer_steps": 5.0}, now=200.0)
+    s.tick(200.0)
+    assert s.states()["trainer_stalled"]["state"] == "ok"
+    # past 300s of no progress, no compile in flight: fires — far
+    # earlier than the old 1800s blanket grace would have allowed
+    at(310.0)
+    s.feed("trainer", {}, {"train/optimizer_steps": 5.0}, now=310.0)
+    s.tick(310.0)
+    assert s.states()["trainer_stalled"]["state"] == "firing"
+    recs = read_alerts(tmp_path)
+    assert recs and recs[0]["rule"] == "trainer_stalled"
+    assert recs[0]["severity"] == "critical"
+
+
+def test_trainer_stalled_suppressed_while_compile_inflight(tmp_path):
+    """A trainer sitting inside a warmup XLA compile makes no optimizer
+    steps but is NOT wedged: the live compile/inflight gauge explains
+    the absence and the rule must stay quiet until the compile drains
+    AND the silence persists."""
+    s, at, cap = make_sentinel(tmp_path, _stalled_rules())
+    at(0.0)
+    s.feed("trainer", {"compile/inflight": 1.0},
+           {"train/optimizer_steps": 5.0}, now=0.0)
+    s.tick(0.0)
+    # 20 minutes inside the compile, zero steps: suppressed throughout
+    for t in (200.0, 400.0, 800.0, 1200.0):
+        at(t)
+        s.feed("trainer", {"compile/inflight": 1.0},
+               {"train/optimizer_steps": 5.0}, now=t)
+        s.tick(t)
+        assert s.states()["trainer_stalled"]["state"] == "ok"
+    assert read_alerts(tmp_path) == []
+    # the compile drains but the trainer STAYS stuck: once the silence
+    # outlives `for:` with no compile in flight, it fires
+    at(1210.0)
+    s.feed("trainer", {"compile/inflight": 0.0},
+           {"train/optimizer_steps": 5.0}, now=1210.0)
+    s.tick(1210.0)
+    assert s.states()["trainer_stalled"]["state"] == "firing"
+    # ...and a compiled-then-progressing trainer would have resolved:
+    at(1220.0)
+    s.feed("trainer", {"compile/inflight": 0.0},
+           {"train/optimizer_steps": 6.0}, now=1220.0)
+    s.tick(1220.0)
+    assert s.states()["trainer_stalled"]["state"] == "ok"
+    events = [r["event"] for r in read_alerts(tmp_path)]
+    assert events == ["firing", "resolved"]
+
+
+def test_name_resolve_inflight_flag_rolls_fire_back(
+        tmp_path, tmp_name_resolve):
+    """The telemetry-flush gap: a worker wedged INSIDE a compile stops
+    flushing metrics (no compile/inflight gauge arrives) but its
+    heartbeat thread still rewrites names.compile_inflight. A fresh flag
+    rolls the fire back to pending exactly like a silence; a stale flag
+    (dead worker's ghost) does not suppress."""
+    s, at, cap = make_sentinel(tmp_path, _stalled_rules())
+    key = names.compile_inflight("sentexp", "t0", "trainer/0")
+    # wall clock starts at 1000.0 in make_sentinel
+    name_resolve.add(key, json.dumps({"ts": 995.0}), replace=True,
+                     delete_on_exit=False)
+    at(310.0)
+    s.tick(310.0)
+    st = s.states()["trainer_stalled"]
+    assert st["state"] == "pending" and st["fires"] == 0
+    assert read_alerts(tmp_path) == []
+    snap = s.registry.snapshot()
+    assert snap["counters"][
+        "sentinel/compile_suppressed{rule=trainer_stalled}"] == 1.0
+    # the flag goes stale (heartbeat stopped rewriting it >60s ago):
+    # a ghost must not suppress — the next tick fires for real
+    at(500.0, 1500.0)
+    s.tick(500.0)
+    st = s.states()["trainer_stalled"]
+    assert st["state"] == "firing" and st["fires"] == 1
+    assert [r["rule"] for r in read_alerts(tmp_path)] == ["trainer_stalled"]

@@ -77,6 +77,18 @@ def _goodput():
                          peak_flops_override=1e12)
 
 
+def _compile_watch():
+    from areal_tpu.api.train_config import CompileWatchConfig
+
+    # Compile & HBM observatory on (docs/observability.md §Compile &
+    # memory): every chip-bearing worker traces its jit entry points and
+    # samples HBM (degrading once on this CPU backend). The LOW storm
+    # warmup lets the injected shape churn in the gen fleet cross the
+    # stability threshold within the short run.
+    return CompileWatchConfig(enabled=True, storm_warmup_calls=4,
+                              mem_sample_interval_secs=0.2)
+
+
 def _serving():
     from areal_tpu.api.train_config import ServingConfig
 
@@ -142,10 +154,36 @@ def _gen_fleet_main(nr_root, data_path, realloc_dir, flight_dir):
                 experiment=EXP, trial=TRIAL, chunk_tokens=4,
                 prompt_bucket=16, batch_window_ms=2, telemetry=tel,
                 serving=_serving(), goodput=_goodput(),
+                compile_watch=_compile_watch(),
             ),
             cfg, params,
         )
         await server.start()
+
+        # Injected recompile storm (ISSUE 20 acceptance): a tiny watched
+        # fn on THIS server's per-instance watch is held shape-stable
+        # past storm_warmup_calls, then fed a never-before-seen shape
+        # every cycle — compile/storm_events climbs at a rate far above
+        # the recompile_storm rule's 0.02/s threshold, and the sentinel
+        # on the master must fire within the rule's `for:` window.
+        import threading
+        import time as _time
+
+        import numpy as _np
+
+        def _storm_forever():
+            probe = server.compile_watch.wrap("e2e/storm_probe",
+                                              lambda x: x)
+            stable = _np.zeros((4,), _np.float32)
+            i = 0
+            while True:
+                for _ in range(4):  # re-stabilize past the warmup window
+                    probe(stable)
+                i += 1
+                probe(_np.zeros((4 + i,), _np.float32))
+                _time.sleep(0.05)
+
+        threading.Thread(target=_storm_forever, daemon=True).start()
         mgr = GserverManager(GserverManagerConfig(
             experiment=EXP, trial=TRIAL, n_servers=1, train_batch_size=4,
             max_head_offpolicyness=4, realloc_dir=realloc_dir,
@@ -223,6 +261,7 @@ def _trainer_main(nr_root, realloc_dir):
         realloc_dir=realloc_dir,
         telemetry=_tel(),
         goodput=_goodput(),
+        compile_watch=_compile_watch(),
     )
     TrainerWorker(cfg).run()
 
@@ -355,6 +394,27 @@ def test_async_ppo_full_loop(tmp_path):
     merged_scrape = []
     sentinel_scrape = []
     goodput_scrape = []
+    compile_scrape = []
+    storm_scrape = []
+
+    def _compile_ready(body):
+        # Compile-observatory acceptance in one snapshot: compile events
+        # from >= 2 worker kinds, the fleet compile-seconds rollup, and
+        # the HBM surface (real gauges on TPU; on this CPU backend the
+        # one-time memory_stats degradation counter).
+        kinds = set()
+        hbm_ok = False
+        for ln in body.splitlines():
+            if ln.startswith("areal_compile_events_total{"):
+                _, _, rest = ln.partition('worker_kind="')
+                kinds.add(rest.partition('"')[0])
+            elif ln.startswith((
+                "areal_hbm_bytes_in_use{",
+                "areal_hbm_memory_stats_unavailable_total{",
+            )):
+                hbm_ok = True
+        return (len(kinds - {"fleet"}) >= 2 and hbm_ok
+                and 'worker_kind="fleet"' in body)
 
     def _goodput_ready(body):
         # Goodput acceptance in one snapshot: ledger counters from >= 3
@@ -377,7 +437,8 @@ def test_async_ppo_full_loop(tmp_path):
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline \
                 and not (merged_scrape and sentinel_scrape
-                         and goodput_scrape):
+                         and goodput_scrape and compile_scrape
+                         and storm_scrape):
             try:
                 with urllib.request.urlopen(
                     f"http://127.0.0.1:{agg_port}/metrics", timeout=5
@@ -398,12 +459,22 @@ def test_async_ppo_full_loop(tmp_path):
                 # Separate capture for the sentinel acceptance: the fired
                 # alert appears on the LIVE merged scrape as
                 # areal_alerts_total{rule,severity} + areal_alert_active.
+                # Keyed on the injected divergence rule specifically — the
+                # recompile-storm probe fires its own alert much earlier,
+                # so "any areal_alerts_total" would capture too soon.
                 if not sentinel_scrape \
-                        and "areal_alerts_total" in body:
+                        and 'rule="e2e_divergence_probe"' in body:
                     sentinel_scrape.append(body)
                 # Third capture for the goodput-ledger acceptance.
                 if not goodput_scrape and _goodput_ready(body):
                     goodput_scrape.append(body)
+                # Fourth/fifth: the compile & HBM observatory, and the
+                # injected recompile storm's alert on the LIVE scrape.
+                if not compile_scrape and _compile_ready(body):
+                    compile_scrape.append(body)
+                if not storm_scrape \
+                        and 'rule="recompile_storm"' in body:
+                    storm_scrape.append(body)
             except Exception:  # noqa: BLE001 — aggregator not up yet
                 pass
             time.sleep(0.3)
@@ -432,6 +503,9 @@ def test_async_ppo_full_loop(tmp_path):
                 sentinel=_sentinel(tmp_path),
                 # Fleet-goodput stitching in the same aggregator.
                 goodput=_goodput(),
+                # Arms the compile-aware sentinel pack (recompile_storm /
+                # hbm_pressure / compile_stall) over the fleet's series.
+                compile_watch=_compile_watch(),
             ),
             _build_async_dfg(),
         )
@@ -665,6 +739,22 @@ def test_async_ppo_full_loop(tmp_path):
         assert ('areal_alerts_total{rule="e2e_divergence_probe",'
                 'severity="critical"') in sentinel_scrape[0]
         assert "areal_alert_active" in sentinel_scrape[0]
+        # (2b) the INJECTED recompile storm (shape churn in the gen
+        # fleet) fired the compile pack's rate rule within its `for:`
+        # window, landed in alerts.jsonl with an evidence bundle, and
+        # hit the live merged scrape.
+        storm_recs = [r for r in alert_recs
+                      if r.get("event") == "firing"
+                      and r.get("rule") == "recompile_storm"]
+        assert storm_recs, alert_recs
+        assert storm_recs[0]["severity"] == "warn"
+        assert storm_recs[0]["metric"] == "compile/storm_events"
+        storm_ev = storm_recs[0].get("evidence_dir")
+        assert storm_ev and os.path.isdir(storm_ev), storm_recs[0]
+        assert storm_scrape, \
+            "merged /metrics never showed the recompile_storm alert"
+        assert 'areal_alerts_total{rule="recompile_storm"' \
+            in storm_scrape[0]
         # --- goodput ledger (docs/observability.md §Goodput) ---
         # The LIVE merged scrape carried goodput_secs_total{state}
         # counters from >= 3 worker kinds, a nonzero stitched
@@ -692,6 +782,28 @@ def test_async_ppo_full_loop(tmp_path):
         assert "areal_train_achieved_tflops" in gbody
         # the generation server's analytic decode FLOP/s rode along
         assert "areal_genserver_decode_tflops" in gbody
+        # --- compile & HBM observatory (docs/observability.md §Compile
+        # & memory) --- the LIVE merged scrape carried compile events
+        # from >= 2 chip-bearing worker kinds (trainer jit sites and the
+        # generation server's prefill/decode wrappers), per-fn compile
+        # seconds with the fleet rollup pseudo-worker, and the HBM
+        # degradation counter (this CPU backend has no memory_stats —
+        # the observatory must say so rather than export empty-chip
+        # zeros).
+        assert compile_scrape, \
+            "merged /metrics never satisfied the compile acceptance"
+        cbody = compile_scrape[0]
+        ckinds = set()
+        for ln in cbody.splitlines():
+            if ln.startswith("areal_compile_events_total{"):
+                _, _, rest = ln.partition('worker_kind="')
+                ckinds.add(rest.partition('"')[0])
+        assert {"trainer", "generation_server"} <= ckinds, ckinds
+        assert ('areal_compile_secs_total{worker_index="0",'
+                'worker_kind="fleet"}') in cbody
+        assert 'fn="train/' in cbody  # trainer jit sites labeled per-fn
+        assert "areal_compile_distinct_shapes" in cbody
+        assert "areal_hbm_memory_stats_unavailable_total" in cbody
         # (3) evidence was captured while the anomaly was live: the
         # bundle holds the alert + triggering metric window + pinned
         # traces, and the fan-out flight-dump trigger pulls rings from

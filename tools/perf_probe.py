@@ -142,6 +142,24 @@ AREAL_NAME_RESOLVE_ROOT when not the default):
                                       first stop of the "did we lose
                                       samples?" runbook
                                       (docs/operations.md)
+  compile-status <exp> <trial>        compile-observatory view of a LIVE
+                                      run (docs/observability.md §Compile
+                                      & memory): per-jit-entry-point
+                                      compile counts / seconds / distinct
+                                      compiled shapes fleet-wide, the
+                                      persistent-cache hit ratio,
+                                      recompile-storm events, and which
+                                      workers are compiling RIGHT NOW —
+                                      the first stop of the "my run is
+                                      wedged in warmup / my step got
+                                      slow" runbook (docs/operations.md)
+  mem-status <exp> <trial>            HBM watermark view of a LIVE run:
+                                      per-worker per-device bytes-in-use
+                                      / peak / limit / utilization plus
+                                      the allocation-site high-water
+                                      marks (weight publish/consume,
+                                      shadow swap, fwd+bwd) —
+                                      docs/weight_sync.md §HBM headroom
   alerts <exp> <trial> [severity] [rule]
                                       training-health sentinel view of a
                                       LIVE run: alert totals + active
@@ -570,6 +588,163 @@ def spool_status(experiment: str, trial: str) -> None:
         if appended:
             print(f"  settled {acked:g}/{appended:g} "
                   f"({in_flight:g} durably queued on disk)")
+
+
+def _merged_metric_rows(experiment: str, trial: str, command: str):
+    """Fetch the aggregator's merged Prometheus scrape and parse it into
+    ``(base_name, labels_dict, value)`` rows (jax-free). Shared by the
+    compile/HBM observatory commands."""
+    import re
+    import urllib.request
+
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        url = name_resolve.get(names.telemetry_http(experiment, trial))
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            body = r.read().decode()
+    except Exception as e:  # noqa: BLE001 — aggregator absent / dead run
+        sys.exit(
+            f"{command}: cannot scrape the merged telemetry endpoint for "
+            f"{experiment}/{trial}: {e}\nNeeds telemetry.enabled=true + "
+            f"telemetry.http_port on the master."
+        )
+    lab_re = re.compile(r'(\w+)="([^"]*)"')
+    rows = []
+    for ln in body.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        base, _, rest = name.partition("{")
+        try:
+            rows.append((base, dict(lab_re.findall(rest)), float(val)))
+        except ValueError:
+            continue
+    return rows
+
+
+def compile_status(experiment: str, trial: str) -> None:
+    """Compile observatory view of a live run (jax-free), from the merged
+    Prometheus scrape: per-jit-entry-point compile counts / total compile
+    seconds / distinct compiled shapes across the fleet, the persistent-
+    cache hit ratio, recompile-storm events, and which workers have a
+    compile in flight RIGHT NOW — the first stop of the "my run is wedged
+    in warmup / my step got slow" runbook (docs/operations.md)."""
+    rows = _merged_metric_rows(experiment, trial, "compile-status")
+    per_fn = {}  # fn -> {events, secs, shapes}
+    inflight = []
+    storms = cache_hits = cache_misses = 0.0
+    for base, labels, val in rows:
+        worker = (f"{labels.get('worker_kind', '?')}:"
+                  f"{labels.get('worker_index', '?')}")
+        fn = labels.get("fn", "?")
+        if base == "areal_compile_events_total":
+            per_fn.setdefault(fn, {})["events"] = \
+                per_fn.get(fn, {}).get("events", 0.0) + val
+        elif base == "areal_compile_secs_total" \
+                and labels.get("worker_kind") != "fleet":
+            per_fn.setdefault(fn, {})["secs"] = \
+                per_fn.get(fn, {}).get("secs", 0.0) + val
+        elif base == "areal_compile_distinct_shapes":
+            d = per_fn.setdefault(fn, {})
+            d["shapes"] = max(d.get("shapes", 0.0), val)
+        elif base == "areal_compile_inflight" and val > 0:
+            inflight.append(worker)
+        elif base == "areal_compile_storm_events_total":
+            storms += val
+        elif base == "areal_compile_cache_hits_total":
+            cache_hits += val
+        elif base == "areal_compile_cache_misses_total":
+            cache_misses += val
+    if not per_fn:
+        sys.exit(
+            "compile-status: no compile metrics on the merged scrape — "
+            "the observatory is off (compile_watch.enabled=false) or no "
+            "watched jit entry point has compiled yet."
+        )
+    w = max(len(fn) for fn in per_fn)
+    print("per-entry-point compile activity (fleet-wide):")
+    print(f"  {'fn':<{w}}  {'compiles':>8}  {'secs':>8}  {'shapes':>6}")
+    for fn in sorted(per_fn):
+        d = per_fn[fn]
+        print(f"  {fn:<{w}}  {d.get('events', 0):>8g}  "
+              f"{d.get('secs', 0):>8.1f}  {d.get('shapes', 0):>6g}")
+    total = cache_hits + cache_misses
+    if total:
+        print(f"persistent cache: {cache_hits:g} hits / "
+              f"{cache_misses:g} misses "
+              f"({100.0 * cache_hits / total:.0f}% hit)")
+    if storms:
+        print(f"RECOMPILE STORMS: {storms:g} storm event(s) — a stable "
+              f"entry point saw new shapes after warmup. Check shape "
+              f"bucketing (serving.max_compiled_shapes, "
+              f"docs/serving.md) and the sentinel's recompile_storm "
+              f"alert evidence.")
+    if inflight:
+        print(f"compiling NOW: {', '.join(sorted(inflight))} — absence "
+              f"alerts (trainer_stalled) are suppressed while these "
+              f"workers compile.")
+    else:
+        print("no compiles in flight.")
+
+
+def mem_status(experiment: str, trial: str) -> None:
+    """HBM watermark view of a live run (jax-free), from the merged
+    Prometheus scrape: per-worker per-device bytes-in-use / peak / limit
+    plus the high-water marks recorded around the big allocators (weight
+    publish/consume, shadow swap, fwd+bwd) — the capacity-planning view
+    of docs/weight_sync.md §HBM headroom."""
+    rows = _merged_metric_rows(experiment, trial, "mem-status")
+    devs = {}   # (worker, device) -> {in_use, peak, limit, util}
+    marks = {}  # (worker, site) -> bytes
+    degraded = 0.0
+    fields = {
+        "areal_hbm_bytes_in_use": "in_use",
+        "areal_hbm_peak_bytes": "peak",
+        "areal_hbm_limit_bytes": "limit",
+        "areal_hbm_utilization": "util",
+    }
+    for base, labels, val in rows:
+        worker = (f"{labels.get('worker_kind', '?')}:"
+                  f"{labels.get('worker_index', '?')}")
+        if base in fields and labels.get("worker_index") != "fleet":
+            key = (worker, labels.get("device", "?"))
+            devs.setdefault(key, {})[fields[base]] = val
+        elif base == "areal_hbm_watermark_bytes":
+            marks[(worker, labels.get("site", "?"))] = val
+        elif base == "areal_hbm_memory_stats_unavailable_total":
+            degraded += val
+    if not devs and not marks and not degraded:
+        sys.exit(
+            "mem-status: no HBM metrics on the merged scrape — the "
+            "observatory is off (compile_watch.enabled=false) or no "
+            "worker has sampled device memory yet."
+        )
+    gib = float(1 << 30)
+    if devs:
+        print("per-device HBM:")
+        print(f"  {'worker':<14}  {'dev':>3}  {'in use':>9}  "
+              f"{'peak':>9}  {'limit':>9}  {'util':>5}")
+        for (worker, dev) in sorted(devs):
+            d = devs[(worker, dev)]
+            limit = d.get("limit", 0.0)
+            util = d.get("util", (d.get("in_use", 0.0) / limit)
+                         if limit else 0.0)
+            print(f"  {worker:<14}  {dev:>3}  "
+                  f"{d.get('in_use', 0) / gib:>8.2f}G  "
+                  f"{d.get('peak', 0) / gib:>8.2f}G  "
+                  f"{limit / gib:>8.2f}G  "
+                  f"{100.0 * util:>4.0f}%")
+    if marks:
+        print("allocation-site high-water marks:")
+        w = max(len(s) for (_, s) in marks)
+        for (worker, site) in sorted(marks, key=lambda k: (k[1], k[0])):
+            print(f"  {site:<{w}}  {marks[(worker, site)] / gib:>8.2f}G  "
+                  f"[{worker}]")
+    if degraded:
+        print(f"note: {degraded:g} worker(s) run on devices without "
+              f"memory_stats() (CPU backend) — HBM gauges absent there "
+              f"by design.")
 
 
 def fleet_status(experiment: str, trial: str) -> None:
@@ -1326,7 +1501,8 @@ def _dispatch_fleet_commands(argv) -> bool:
                                    "uncordon", "reward-bench", "alerts",
                                    "silence", "goodput", "reshard-bench",
                                    "ring-bench", "moe-bench",
-                                   "spool-status"):
+                                   "spool-status", "compile-status",
+                                   "mem-status"):
         return False
     cmd = argv[0]
     try:
@@ -1334,6 +1510,10 @@ def _dispatch_fleet_commands(argv) -> bool:
             fleet_status(argv[1], argv[2])
         elif cmd == "spool-status":
             spool_status(argv[1], argv[2])
+        elif cmd == "compile-status":
+            compile_status(argv[1], argv[2])
+        elif cmd == "mem-status":
+            mem_status(argv[1], argv[2])
         elif cmd == "cordon":
             cordon(argv[1], argv[2], argv[3],
                    " ".join(argv[4:]) or "operator request")
